@@ -1,0 +1,29 @@
+"""cruise_control_tpu — a TPU-native cluster-balancing framework.
+
+A ground-up, JAX/XLA-first re-design of the capabilities of LinkedIn Cruise
+Control (reference: /root/reference, Java).  The reference keeps a mutable
+object graph (racks -> hosts -> brokers -> disks -> replicas, each with a
+windowed ``Load``) and runs a priority-ordered list of greedy per-broker goal
+optimizers over it.  Here the cluster is a frozen structure-of-arrays snapshot
+(``model.ClusterState``), goals are vectorized violation/cost/acceptance
+functions over that state (``goals``), and the greedy search is a batched,
+jit-compiled move-selection kernel (``analyzer.solver``) that evaluates whole
+replica x broker cost/feasibility tensors per round on the MXU.
+
+Subpackage map (reference layer in parentheses — see SURVEY.md):
+
+- ``common``    actions, resources, exceptions          (common/, analyzer/BalancingAction)
+- ``config``    typed config system + defaults          (config/, cruise-control-core ConfigDef)
+- ``model``     tensor cluster model + builder + stats  (model/)
+- ``goals``     goal semantics as masks & costs         (analyzer/goals/)
+- ``analyzer``  goal optimizer + solver kernels         (analyzer/GoalOptimizer)
+- ``monitor``   windowed metric aggregation -> snapshots (monitor/, cruise-control-core aggregator)
+- ``executor``  proposal execution state machine        (executor/)
+- ``detector``  anomaly detection + self-healing        (detector/)
+- ``server``    REST API + user task manager            (servlet/)
+- ``client``    CLI client                              (cruise-control-client/)
+- ``parallel``  mesh/sharding for multi-chip solves     (no reference analog; ICI scale-out)
+- ``ops``       low-level JAX/Pallas kernels            (no reference analog)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,44 @@
+"""``tpucc`` — command-line client.
+
+Reference: ``cruise-control-client/cruisecontrolclient/client/cccli.py`` (the
+``cccli`` console script).  Subcommands mirror the REST endpoints; offline
+subcommands (``propose``) run the analyzer locally on a snapshot file without
+a server — the round-1 end-to-end slice.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpucc",
+        description="TPU-native Cruise Control client",
+    )
+    sub = parser.add_subparsers(dest="command")
+    sub.required = False
+
+    propose = sub.add_parser("propose", help="compute rebalance proposals for a snapshot file")
+    propose.add_argument("--snapshot", required=True, help="path to a cluster snapshot (.json)")
+    propose.add_argument("--goals", default=None,
+                         help="comma-separated goal names (default: default.goals config)")
+    propose.add_argument("--verbose", action="store_true")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command is None:
+        build_parser().print_help()
+        return 0
+    if args.command == "propose":
+        # Imported lazily: jax startup is slow and irrelevant for --help.
+        from cruise_control_tpu.client.propose import run_propose
+        return run_propose(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

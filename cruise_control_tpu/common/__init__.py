@@ -1,0 +1,13 @@
+from cruise_control_tpu.common.resources import Resource, NUM_RESOURCES
+from cruise_control_tpu.common.actions import (
+    ActionType,
+    ActionAcceptance,
+    BalancingAction,
+    ExecutionProposal,
+)
+from cruise_control_tpu.common.exceptions import (
+    CruiseControlError,
+    OptimizationFailureError,
+    NotEnoughValidWindowsError,
+    OngoingExecutionError,
+)

@@ -1,0 +1,68 @@
+"""Resource taxonomy.
+
+Mirrors the reference's ``common/Resource.java:18-97``: four resources with
+host/broker scoping and utilization-comparison epsilons.  Here a resource is
+just an index into axis -1 of every load/capacity tensor, so the enum is an
+``IntEnum`` and the scoping/epsilon tables are plain numpy arrays that kernels
+can close over.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+NUM_RESOURCES = 4
+
+
+class Resource(enum.IntEnum):
+    """CPU is host- and broker-scoped; NW_IN/NW_OUT host-scoped; DISK broker-scoped."""
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def resource(self) -> str:
+        return _NAMES[self.value]
+
+    @property
+    def is_host_resource(self) -> bool:
+        return bool(IS_HOST_RESOURCE[self.value])
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return bool(IS_BROKER_RESOURCE[self.value])
+
+    @classmethod
+    def cached_values(cls) -> tuple["Resource", ...]:
+        return _CACHED
+
+    @classmethod
+    def from_name(cls, name: str) -> "Resource":
+        try:
+            return _BY_NAME[name.lower()]
+        except KeyError:
+            raise ValueError(f"unknown resource name: {name!r}") from None
+
+    def epsilon(self, value1: float, value2: float) -> float:
+        """Comparison tolerance: max of a per-resource floor and a relative term
+        (float-summation noise grows with cluster size; reference uses 0.08%)."""
+        return max(float(EPSILON_FLOOR[self.value]), EPSILON_PERCENT * (value1 + value2))
+
+
+_NAMES = ("cpu", "networkInbound", "networkOutbound", "disk")
+_BY_NAME = {"cpu": Resource.CPU, "networkinbound": Resource.NW_IN,
+            "networkoutbound": Resource.NW_OUT, "disk": Resource.DISK,
+            "nw_in": Resource.NW_IN, "nw_out": Resource.NW_OUT}
+_CACHED = (Resource.CPU, Resource.NW_IN, Resource.NW_OUT, Resource.DISK)
+
+# Scoping masks, indexable by resource id inside jitted code.
+IS_HOST_RESOURCE = np.array([True, True, True, False])
+IS_BROKER_RESOURCE = np.array([True, False, False, True])
+
+# Per-resource absolute epsilon floor and shared relative epsilon.
+EPSILON_FLOOR = np.array([0.001, 10.0, 10.0, 100.0], dtype=np.float64)
+EPSILON_PERCENT = 0.0008

@@ -1,0 +1,347 @@
+"""Host-side mutable cluster model builder.
+
+This is the boundary between the outside world (metadata + metric samples, or
+test fixtures) and the tensor model.  It mirrors the reference ClusterModel's
+mutation API — ``createBroker`` :923-940, ``createReplica`` :802-883,
+``setReplicaLoad`` :740-764, ``relocateReplica`` :375-389,
+``relocateLeadership`` :402-434, ``setBrokerState`` :292-331,
+``createOrDeleteReplicas`` :962-1027 — but exists only to *construct* snapshots:
+``freeze()`` emits the (ClusterState, Placement, ClusterMeta) triple and all
+optimization happens on those tensors, never on this object graph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource, NUM_RESOURCES
+from cruise_control_tpu.model import cpu_model
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement, make_state
+
+LoadLike = Union[Dict[Resource, float], Sequence[float], np.ndarray]
+
+
+def _load_array(load: LoadLike) -> np.ndarray:
+    if isinstance(load, dict):
+        arr = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        for k, v in load.items():
+            arr[int(k)] = v
+        return arr
+    arr = np.asarray(load, dtype=np.float64)
+    if arr.shape != (NUM_RESOURCES,):
+        raise ValueError(f"load must have {NUM_RESOURCES} entries, got {arr.shape}")
+    return arr.copy()
+
+
+@dataclass
+class Replica:
+    topic: str
+    partition: int
+    broker_id: int
+    is_leader: bool
+    disk: int = 0
+    leader_load: np.ndarray = field(default_factory=lambda: np.zeros(NUM_RESOURCES))
+    follower_load: Optional[np.ndarray] = None  # derived from leader_load if None
+    offline: bool = False
+    orig_broker: Optional[int] = None
+
+    def effective_follower_load(self) -> np.ndarray:
+        if self.follower_load is not None:
+            return self.follower_load
+        fl = self.leader_load.copy()
+        fl[Resource.NW_OUT] = 0.0
+        fl[Resource.CPU] = cpu_model.follower_cpu_from_leader_load(
+            self.leader_load[Resource.NW_IN], self.leader_load[Resource.NW_OUT],
+            self.leader_load[Resource.CPU])
+        return fl
+
+
+@dataclass
+class Broker:
+    broker_id: int
+    rack: str
+    host: str
+    capacity: np.ndarray                      # f64[4]
+    disk_capacities: np.ndarray               # f64[D>=1]
+    alive: bool = True
+    new_broker: bool = False
+    demoted: bool = False
+    disk_alive: Optional[np.ndarray] = None   # bool[D]
+
+    def __post_init__(self):
+        if self.disk_alive is None:
+            self.disk_alive = np.ones(len(self.disk_capacities), dtype=bool)
+
+
+class ClusterModel:
+    """Mutable cluster under construction; ``freeze()`` emits tensors."""
+
+    def __init__(self):
+        self._brokers: Dict[int, Broker] = {}
+        # (topic, partition) -> ordered replica list (index 0 need not be leader;
+        # ``pos`` order is the Kafka replica-list order; exactly one is_leader).
+        self._partitions: Dict[Tuple[str, int], List[Replica]] = {}
+        self._rack_order: List[str] = []
+        self._host_order: List[str] = []
+
+    # ------------------------------------------------------------------ brokers
+
+    def create_broker(self, rack: str, host: str, broker_id: int, capacity: LoadLike,
+                      disk_capacities: Optional[Sequence[float]] = None,
+                      new_broker: bool = False) -> Broker:
+        if broker_id in self._brokers:
+            raise ValueError(f"broker {broker_id} already exists")
+        cap = _load_array(capacity)
+        if disk_capacities is None:
+            disks = np.array([cap[Resource.DISK]], dtype=np.float64)
+        else:
+            disks = np.asarray(disk_capacities, dtype=np.float64)
+            cap[Resource.DISK] = disks.sum()
+        b = Broker(broker_id, rack, host, cap, disks, new_broker=new_broker)
+        self._brokers[broker_id] = b
+        if rack not in self._rack_order:
+            self._rack_order.append(rack)
+        if host not in self._host_order:
+            self._host_order.append(host)
+        return b
+
+    def broker(self, broker_id: int) -> Broker:
+        return self._brokers[broker_id]
+
+    def brokers(self) -> List[Broker]:
+        return list(self._brokers.values())
+
+    def _placement_offline(self, broker_id: int, disk: int) -> bool:
+        """A replica is offline when its broker or its logdir is dead."""
+        b = self._brokers[broker_id]
+        return (not b.alive) or disk >= len(b.disk_alive) or not bool(b.disk_alive[disk])
+
+    def set_broker_state(self, broker_id: int, alive: bool) -> None:
+        """Reference ClusterModel.setBrokerState :292-331: killing a broker marks
+        its replicas offline (they must be moved off)."""
+        self._brokers[broker_id].alive = alive
+        for replicas in self._partitions.values():
+            for r in replicas:
+                if r.broker_id == broker_id:
+                    r.offline = self._placement_offline(broker_id, r.disk)
+
+    def mark_disk_dead(self, broker_id: int, disk: int) -> None:
+        """Reference ClusterModel.markDiskDead :340."""
+        b = self._brokers[broker_id]
+        b.disk_alive[disk] = False
+        b.capacity[Resource.DISK] = b.disk_capacities[b.disk_alive].sum()
+        for replicas in self._partitions.values():
+            for r in replicas:
+                if r.broker_id == broker_id and r.disk == disk:
+                    r.offline = True
+
+    # ----------------------------------------------------------------- replicas
+
+    def create_replica(self, topic: str, partition: int, broker_id: int, index: int,
+                       is_leader: bool, disk: int = 0) -> Replica:
+        if broker_id not in self._brokers:
+            raise ValueError(f"unknown broker {broker_id}")
+        key = (topic, partition)
+        replicas = self._partitions.setdefault(key, [])
+        if any(r.broker_id == broker_id for r in replicas):
+            raise ValueError(f"partition {key} already has a replica on broker {broker_id}")
+        if is_leader and any(r.is_leader for r in replicas):
+            raise ValueError(f"partition {key} already has a leader")
+        if index < 0:
+            raise ValueError(f"replica-list index must be >= 0, got {index}")
+        r = Replica(topic, partition, broker_id, is_leader,
+                    disk=disk, orig_broker=broker_id,
+                    offline=self._placement_offline(broker_id, disk))
+        replicas.insert(min(index, len(replicas)), r)
+        return r
+
+    def replica(self, topic: str, partition: int, broker_id: int) -> Replica:
+        for r in self._partitions[(topic, partition)]:
+            if r.broker_id == broker_id:
+                return r
+        raise KeyError(f"no replica of {topic}-{partition} on broker {broker_id}")
+
+    def partition(self, topic: str, partition: int) -> List[Replica]:
+        return self._partitions[(topic, partition)]
+
+    def partitions(self) -> Dict[Tuple[str, int], List[Replica]]:
+        return self._partitions
+
+    def set_replica_load(self, topic: str, partition: int, broker_id: int,
+                         load: LoadLike, follower_load: Optional[LoadLike] = None) -> None:
+        """Set a replica's leader-role load; follower-role load is derived via
+        the CPU model unless given explicitly (reference: setReplicaLoad
+        :740-764 + MonitorUtils.populatePartitionLoad :382-447)."""
+        r = self.replica(topic, partition, broker_id)
+        r.leader_load = _load_array(load)
+        r.follower_load = None if follower_load is None else _load_array(follower_load)
+
+    def delete_replica(self, topic: str, partition: int, broker_id: int) -> None:
+        replicas = self._partitions[(topic, partition)]
+        r = self.replica(topic, partition, broker_id)
+        if r.is_leader and len(replicas) > 1:
+            raise ValueError("cannot delete the leader while followers exist")
+        replicas.remove(r)
+        if not replicas:
+            del self._partitions[(topic, partition)]
+
+    def relocate_replica(self, topic: str, partition: int, src_broker: int, dst_broker: int,
+                         dst_disk: int = 0) -> None:
+        r = self.replica(topic, partition, src_broker)
+        if any(x.broker_id == dst_broker for x in self._partitions[(topic, partition)]):
+            raise ValueError(f"{topic}-{partition} already on broker {dst_broker}")
+        r.broker_id = dst_broker
+        r.disk = dst_disk
+        r.offline = self._placement_offline(dst_broker, dst_disk)
+
+    def relocate_leadership(self, topic: str, partition: int, src_broker: int,
+                            dst_broker: int) -> bool:
+        src = self.replica(topic, partition, src_broker)
+        if not src.is_leader:
+            return False
+        dst = self.replica(topic, partition, dst_broker)
+        if dst.is_leader:
+            raise ValueError("destination is already the leader")
+        src.is_leader = False
+        dst.is_leader = True
+        return True
+
+    def create_or_delete_replicas(self, topic: str, target_rf: int,
+                                  broker_order: Optional[List[int]] = None) -> None:
+        """Change replication factor of a topic (reference: ClusterModel.
+        createOrDeleteReplicas :962-1027).  New replicas are placed round-robin
+        over alive brokers not already holding the partition; deletions drop
+        the last non-leader replicas."""
+        order = broker_order or sorted(b.broker_id for b in self._brokers.values() if b.alive)
+        cursor = 0
+        for (t, p), replicas in list(self._partitions.items()):
+            if t != topic:
+                continue
+            while len(replicas) > target_rf:
+                victim = next((r for r in reversed(replicas) if not r.is_leader), None)
+                if victim is None:
+                    raise ValueError(
+                        f"cannot reduce {t}-{p} to rf={target_rf}: only the leader remains")
+                replicas.remove(victim)
+            holders = {r.broker_id for r in replicas}
+            while len(replicas) < target_rf:
+                for _ in range(len(order)):
+                    cand = order[cursor % len(order)]
+                    cursor += 1
+                    if cand not in holders:
+                        break
+                else:
+                    raise ValueError(f"not enough brokers for rf={target_rf}")
+                r = Replica(t, p, cand, is_leader=False, orig_broker=cand)
+                # Followers inherit the partition's follower-role load profile.
+                leader = next(x for x in replicas if x.is_leader)
+                r.leader_load = leader.leader_load.copy()
+                replicas.append(r)
+                holders.add(cand)
+
+    # ------------------------------------------------------------------- freeze
+
+    def freeze(self, pad_replicas_to: int = 1, pad_brokers_to: int = 1,
+               ) -> Tuple[ClusterState, Placement, ClusterMeta]:
+        broker_ids = list(self._brokers.keys())
+        broker_index = {b: i for i, b in enumerate(broker_ids)}
+        racks = list(self._rack_order)
+        hosts = list(self._host_order)
+        rack_index = {r: i for i, r in enumerate(racks)}
+        host_index = {h: i for i, h in enumerate(hosts)}
+
+        topics: List[str] = []
+        topic_index: Dict[str, int] = {}
+        partitions: List[Tuple[int, int]] = []
+        replica_rows: List[Replica] = []
+        part_of_replica: List[int] = []
+        pos_of_replica: List[int] = []
+        for (t, p), replicas in self._partitions.items():
+            if t not in topic_index:
+                topic_index[t] = len(topics)
+                topics.append(t)
+            pid = len(partitions)
+            partitions.append((topic_index[t], p))
+            for pos, r in enumerate(replicas):
+                replica_rows.append(r)
+                part_of_replica.append(pid)
+                pos_of_replica.append(pos)
+
+        r_n = len(replica_rows)
+        b_n = len(broker_ids)
+        d_n = max((len(b.disk_capacities) for b in self._brokers.values()), default=1)
+
+        leader_load = np.zeros((r_n, NUM_RESOURCES))
+        follower_load = np.zeros((r_n, NUM_RESOURCES))
+        assignment = np.zeros(r_n, dtype=np.int64)
+        disk = np.zeros(r_n, dtype=np.int64)
+        is_leader = np.zeros(r_n, dtype=bool)
+        topic_arr = np.zeros(r_n, dtype=np.int64)
+        orig_broker = np.zeros(r_n, dtype=np.int64)
+        offline = np.zeros(r_n, dtype=bool)
+        for i, r in enumerate(replica_rows):
+            leader_load[i] = r.leader_load
+            follower_load[i] = r.effective_follower_load()
+            assignment[i] = broker_index[r.broker_id]
+            disk[i] = r.disk
+            is_leader[i] = r.is_leader
+            topic_arr[i] = topic_index[r.topic]
+            orig_broker[i] = broker_index.get(r.orig_broker, broker_index[r.broker_id])
+            offline[i] = r.offline
+
+        capacity = np.zeros((b_n, NUM_RESOURCES))
+        host_arr = np.zeros(b_n, dtype=np.int64)
+        rack_arr = np.zeros(b_n, dtype=np.int64)
+        alive = np.zeros(b_n, dtype=bool)
+        new_broker = np.zeros(b_n, dtype=bool)
+        disk_capacity = np.zeros((b_n, d_n))
+        disk_alive = np.zeros((b_n, d_n), dtype=bool)
+        for i, bid in enumerate(broker_ids):
+            b = self._brokers[bid]
+            capacity[i] = b.capacity
+            host_arr[i] = host_index[b.host]
+            rack_arr[i] = rack_index[b.rack]
+            alive[i] = b.alive
+            new_broker[i] = b.new_broker
+            nd = len(b.disk_capacities)
+            disk_capacity[i, :nd] = b.disk_capacities
+            disk_alive[i, :nd] = b.disk_alive
+
+        state, placement = make_state(
+            dict(leader_load=leader_load, follower_load=follower_load,
+                 partition=np.asarray(part_of_replica), topic=topic_arr,
+                 pos=np.asarray(pos_of_replica), orig_broker=orig_broker,
+                 offline=offline, assignment=assignment, disk=disk,
+                 is_leader=is_leader, capacity=capacity, host=host_arr,
+                 rack=rack_arr, alive=alive, new_broker=new_broker,
+                 disk_capacity=disk_capacity, disk_alive=disk_alive),
+            pad_replicas_to=pad_replicas_to, pad_brokers_to=pad_brokers_to,
+        )
+        meta = ClusterMeta(broker_ids=broker_ids, topics=topics, partitions=partitions,
+                           racks=racks, hosts=hosts, num_replicas=r_n, num_brokers=b_n)
+        return state, placement, meta
+
+    # ---------------------------------------------------------------- apply-back
+
+    def apply_placement(self, placement: Placement, meta: ClusterMeta) -> None:
+        """Mutate this model to match an optimized placement (used by tests and
+        by multi-goal host orchestration when a goal runs on the builder)."""
+        broker = np.asarray(placement.broker)
+        disk = np.asarray(placement.disk)
+        is_leader = np.asarray(placement.is_leader)
+        total = sum(len(rs) for rs in self._partitions.values())
+        if total != meta.num_replicas:
+            raise ValueError(
+                f"placement holds {meta.num_replicas} replicas but model has {total}; "
+                "was the model edited after freeze()?")
+        i = 0
+        for (t, p), replicas in self._partitions.items():
+            for r in replicas:
+                r.broker_id = meta.broker_ids[int(broker[i])]
+                r.disk = int(disk[i])
+                r.is_leader = bool(is_leader[i])
+                r.offline = self._placement_offline(r.broker_id, r.disk)
+                i += 1

@@ -1,0 +1,168 @@
+"""Pure tensor queries over (ClusterState, Placement).
+
+These replace the reference's incremental load bookkeeping: where
+``ClusterModel.relocateReplica``/``relocateLeadership`` (ClusterModel.java:
+375-434) push load deltas up the replica->broker->host->rack tree, we recompute
+aggregate views with segment-sums — O(R) work the TPU does in microseconds, and
+trivially correct under any batch of simultaneous moves.
+
+All functions are jit-safe (static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import ClusterState, Placement
+
+
+def effective_load(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[R, 4]: each replica's load in its current role, zeroed for padding."""
+    load = jnp.where(placement.is_leader[:, None], state.leader_load, state.follower_load)
+    return load * state.valid[:, None]
+
+
+def broker_load(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[B, 4]: per-broker utilization (sum of effective replica loads)."""
+    return jax.ops.segment_sum(
+        effective_load(state, placement), placement.broker,
+        num_segments=state.num_brokers_padded,
+    )
+
+
+def host_load(state: ClusterState, placement: Placement, num_hosts: int) -> jnp.ndarray:
+    """f32[H, 4]: per-host utilization (brokers aggregated by host).
+
+    Host scope matters for CPU/NW capacity checks (Resource.java: CPU+NW are
+    host resources).
+    """
+    return jax.ops.segment_sum(broker_load(state, placement), state.host, num_segments=num_hosts)
+
+
+def disk_load(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[B, D]: per-logdir DISK utilization for JBOD brokers."""
+    flat = placement.broker * state.num_disks_per_broker + placement.disk
+    sums = jax.ops.segment_sum(
+        effective_load(state, placement)[:, Resource.DISK], flat,
+        num_segments=state.num_brokers_padded * state.num_disks_per_broker,
+    )
+    return sums.reshape(state.num_brokers_padded, state.num_disks_per_broker)
+
+
+def potential_leadership_load(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[B]: NW_OUT if a broker led *all* its replicas.
+
+    Reference: ``ClusterModel._potentialLeadershipLoadByBrokerId`` maintained in
+    ``setReplicaLoad`` (ClusterModel.java:740-764), consumed by PotentialNwOutGoal.
+    """
+    pot = state.leader_load[:, Resource.NW_OUT] * state.valid
+    return jax.ops.segment_sum(pot, placement.broker, num_segments=state.num_brokers_padded)
+
+
+def replica_counts(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """i32[B]: replicas per broker."""
+    return jax.ops.segment_sum(
+        state.valid.astype(jnp.int32), placement.broker,
+        num_segments=state.num_brokers_padded,
+    )
+
+
+def leader_counts(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """i32[B]: leader replicas per broker."""
+    return jax.ops.segment_sum(
+        (state.valid & placement.is_leader).astype(jnp.int32), placement.broker,
+        num_segments=state.num_brokers_padded,
+    )
+
+
+def topic_broker_counts(state: ClusterState, placement: Placement, num_topics: int) -> jnp.ndarray:
+    """i32[T, B]: replicas of each topic on each broker (TopicReplicaDistributionGoal)."""
+    b = state.num_brokers_padded
+    flat = state.topic * b + placement.broker
+    counts = jax.ops.segment_sum(
+        state.valid.astype(jnp.int32), flat, num_segments=num_topics * b,
+    )
+    return counts.reshape(num_topics, b)
+
+
+def topic_leader_counts(state: ClusterState, placement: Placement, num_topics: int) -> jnp.ndarray:
+    """i32[T, B]: leaders of each topic on each broker (MinTopicLeadersPerBrokerGoal)."""
+    b = state.num_brokers_padded
+    flat = state.topic * b + placement.broker
+    counts = jax.ops.segment_sum(
+        (state.valid & placement.is_leader).astype(jnp.int32), flat,
+        num_segments=num_topics * b,
+    )
+    return counts.reshape(num_topics, b)
+
+
+def partition_rack_counts(state: ClusterState, placement: Placement, num_racks: int,
+                          num_partitions: int) -> jnp.ndarray:
+    """i32[P, K]: replicas of each partition on each rack (rack-awareness goals)."""
+    rack_of_replica = state.rack[placement.broker]
+    flat = state.partition * num_racks + rack_of_replica
+    counts = jax.ops.segment_sum(
+        state.valid.astype(jnp.int32), flat, num_segments=num_partitions * num_racks,
+    )
+    return counts.reshape(num_partitions, num_racks)
+
+
+def partition_broker_matrix(state: ClusterState, placement: Placement,
+                            num_partitions: int) -> jnp.ndarray:
+    """bool[P, B]: does partition p have a replica on broker b.
+
+    Dense P×B is too big at the 1M-replica scale — use only on small models
+    (tests); goals use replica-indexed forms instead.
+    """
+    b = state.num_brokers_padded
+    flat = state.partition * b + placement.broker
+    counts = jax.ops.segment_sum(
+        state.valid.astype(jnp.int32), flat, num_segments=num_partitions * b,
+    )
+    return (counts > 0).reshape(num_partitions, b)
+
+
+def replicas_on_same_rack(state: ClusterState, placement: Placement,
+                          num_racks: int, num_partitions: int) -> jnp.ndarray:
+    """i32[R]: for each replica, how many *sibling* replicas of its partition
+    share its rack (0 == rack-aware ok)."""
+    prc = partition_rack_counts(state, placement, num_racks, num_partitions)
+    rack_of_replica = state.rack[placement.broker]
+    return prc[state.partition, rack_of_replica] - 1
+
+
+def partition_leader_broker(state: ClusterState, placement: Placement,
+                            num_partitions: int) -> jnp.ndarray:
+    """i32[P]: broker index of each partition's leader (-1 if none/invalid)."""
+    contrib = jnp.where(state.valid & placement.is_leader, placement.broker + 1, 0)
+    got = jax.ops.segment_max(contrib, state.partition, num_segments=num_partitions)
+    return got - 1
+
+
+def partition_size(state: ClusterState, num_partitions: int) -> jnp.ndarray:
+    """f32[P]: disk size of one replica of each partition (max over replicas)."""
+    return jax.ops.segment_max(
+        jnp.where(state.valid, state.leader_load[:, Resource.DISK], 0.0),
+        state.partition, num_segments=num_partitions,
+    )
+
+
+def average_alive_utilization(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[4]: cluster-wide utilization / capacity over alive brokers.
+
+    Reference: ClusterModel.load() vs aliveCapacityFor — the baseline for
+    ResourceDistributionGoal's balance band.
+    """
+    total_load = jnp.sum(broker_load(state, placement) * state.broker_valid[:, None], axis=0)
+    alive = state.alive & state.broker_valid
+    total_cap = jnp.sum(state.capacity * alive[:, None], axis=0)
+    return total_load / jnp.maximum(total_cap, 1e-9)
+
+
+def utilization_matrix(state: ClusterState, placement: Placement) -> jnp.ndarray:
+    """f32[4, B]: per-resource utilization fraction per broker
+    (reference: ClusterModel.utilizationMatrix :1323-1357)."""
+    load = broker_load(state, placement)
+    return (load / jnp.maximum(state.capacity, 1e-9)).T

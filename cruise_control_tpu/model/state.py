@@ -1,0 +1,201 @@
+"""Frozen structure-of-arrays cluster model.
+
+The reference keeps a mutable object graph (``model/ClusterModel.java:48-1388``:
+racks -> hosts -> brokers -> disks -> replicas, each owning a windowed ``Load``)
+and goals mutate it replica-by-replica.  Its own ``utilizationMatrix``
+(ClusterModel.java:1323-1357) already shows the model collapses to matrices —
+here that collapse is the primary representation:
+
+- ``ClusterState``  — immutable per-replica / per-broker tensors (the "what is").
+- ``Placement``     — the three mutable arrays the optimizer actually changes:
+  replica->broker assignment, replica->disk assignment, and leadership.
+- ``ClusterMeta``   — static host-side identity info (names, id maps, sizes);
+  never traced.
+
+Every array is padded to a static size so jitted solvers never recompile when
+brokers die or replicas appear; ``valid`` / ``broker_valid`` masks gate padding.
+
+Load semantics: the reference stores a replica's *current-role* load and
+transfers NW_OUT fully plus a CPU fraction on leadership moves
+(``ClusterModel.relocateLeadership`` :402-434).  We instead store both potential
+roles per replica (``leader_load`` / ``follower_load``); the effective load is
+selected by the leadership mask, which makes leadership transfer a pure mask
+flip instead of an in-place load mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+
+
+@flax.struct.dataclass
+class Placement:
+    """The optimizer-mutable part of the cluster: where replicas sit and who leads.
+
+    Shapes: ``broker``/``disk``/``is_leader`` are [R]; padded entries hold
+    broker 0 / disk 0 / False and are masked out by ``ClusterState.valid``.
+    """
+
+    broker: jnp.ndarray    # i32[R] dense broker index
+    disk: jnp.ndarray      # i32[R] disk index within broker (0 if non-JBOD)
+    is_leader: jnp.ndarray  # bool[R]
+
+
+@flax.struct.dataclass
+class ClusterState:
+    """Immutable cluster tensors (padded, static-shaped)."""
+
+    # --- replica axis [R] ---
+    leader_load: jnp.ndarray    # f32[R, 4] load if this replica leads
+    follower_load: jnp.ndarray  # f32[R, 4] load if it follows (NW_OUT=0, reduced CPU)
+    partition: jnp.ndarray      # i32[R] dense partition id in [0, P)
+    topic: jnp.ndarray          # i32[R] dense topic id in [0, T)
+    pos: jnp.ndarray            # i32[R] index in the partition's replica list (0 = preferred leader)
+    orig_broker: jnp.ndarray    # i32[R] broker at snapshot time (immigrant tracking)
+    offline: jnp.ndarray        # bool[R] replica currently on a dead broker/disk
+    valid: jnp.ndarray          # bool[R] padding mask
+
+    # --- broker axis [B] ---
+    capacity: jnp.ndarray       # f32[B, 4]; dead brokers get 0 effective capacity via masks
+    host: jnp.ndarray           # i32[B] dense host id in [0, H)
+    rack: jnp.ndarray           # i32[B] dense rack id in [0, K)
+    alive: jnp.ndarray          # bool[B]
+    new_broker: jnp.ndarray     # bool[B] recently-added broker (add_broker scenarios)
+    broker_valid: jnp.ndarray   # bool[B] padding mask
+
+    # --- disk axis [B, D] (D = max logdirs per broker; 1 when non-JBOD) ---
+    disk_capacity: jnp.ndarray  # f32[B, D]
+    disk_alive: jnp.ndarray     # bool[B, D]
+
+    @property
+    def num_replicas_padded(self) -> int:
+        return self.leader_load.shape[0]
+
+    @property
+    def num_brokers_padded(self) -> int:
+        return self.capacity.shape[0]
+
+    @property
+    def num_disks_per_broker(self) -> int:
+        return self.disk_capacity.shape[1]
+
+
+class ClusterMeta:
+    """Static, host-side identity info for a snapshot. Never traced.
+
+    Maps dense indices used in ``ClusterState`` back to external identities
+    (Kafka broker ids, topic names, rack/host names, topic-partitions).
+    """
+
+    def __init__(
+        self,
+        broker_ids: List[int],
+        topics: List[str],
+        partitions: List[Tuple[int, int]],   # dense pid -> (dense topic id, partition number)
+        racks: List[str],
+        hosts: List[str],
+        num_replicas: int,
+        num_brokers: int,
+        extra: Optional[Dict[str, Any]] = None,
+    ):
+        self.broker_ids = broker_ids          # dense broker idx -> Kafka broker id
+        self.topics = topics                  # dense topic idx -> topic name
+        self.partitions = partitions          # dense pid -> (topic idx, partition)
+        self.racks = racks
+        self.hosts = hosts
+        self.num_replicas = num_replicas      # true (unpadded) counts
+        self.num_brokers = num_brokers
+        self.extra = extra or {}
+        self.broker_index = {b: i for i, b in enumerate(broker_ids)}
+        self.topic_index = {t: i for i, t in enumerate(topics)}
+        self.partition_index = {tp: i for i, tp in enumerate(partitions)}
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    @property
+    def num_topics(self) -> int:
+        return len(self.topics)
+
+    @property
+    def num_racks(self) -> int:
+        return len(self.racks)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    def tp_name(self, pid: int) -> str:
+        t, p = self.partitions[pid]
+        return f"{self.topics[t]}-{p}"
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return max(n, 1)
+    return max(((n + multiple - 1) // multiple) * multiple, multiple)
+
+
+def make_state(
+    arrays: Dict[str, np.ndarray],
+    pad_replicas_to: int = 1,
+    pad_brokers_to: int = 1,
+) -> Tuple[ClusterState, Placement]:
+    """Pack host numpy arrays into (ClusterState, Placement) with padding.
+
+    ``arrays`` holds unpadded per-replica and per-broker arrays keyed by the
+    field names of ClusterState/Placement.  Padding multiples let callers keep
+    jit caches warm across snapshots of slightly different size (pad replicas
+    to e.g. 8192, brokers to 128 → recompiles only on size-class change).
+    """
+    r = arrays["leader_load"].shape[0]
+    b = arrays["capacity"].shape[0]
+    rp = _pad_to(r, pad_replicas_to)
+    bp = _pad_to(b, pad_brokers_to)
+
+    def padr(x: np.ndarray, fill=0) -> np.ndarray:
+        if x.shape[0] == rp:
+            return x
+        pad = [(0, rp - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, pad, constant_values=fill)
+
+    def padb(x: np.ndarray, fill=0) -> np.ndarray:
+        if x.shape[0] == bp:
+            return x
+        pad = [(0, bp - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, pad, constant_values=fill)
+
+    valid = padr(np.ones(r, dtype=bool), False)
+    broker_valid = padb(np.ones(b, dtype=bool), False)
+
+    state = ClusterState(
+        leader_load=jnp.asarray(padr(arrays["leader_load"].astype(np.float32))),
+        follower_load=jnp.asarray(padr(arrays["follower_load"].astype(np.float32))),
+        partition=jnp.asarray(padr(arrays["partition"].astype(np.int32))),
+        topic=jnp.asarray(padr(arrays["topic"].astype(np.int32))),
+        pos=jnp.asarray(padr(arrays["pos"].astype(np.int32))),
+        orig_broker=jnp.asarray(padr(arrays["orig_broker"].astype(np.int32))),
+        offline=jnp.asarray(padr(arrays.get("offline", np.zeros(r, dtype=bool)).astype(bool))),
+        valid=jnp.asarray(valid),
+        capacity=jnp.asarray(padb(arrays["capacity"].astype(np.float32))),
+        host=jnp.asarray(padb(arrays["host"].astype(np.int32))),
+        rack=jnp.asarray(padb(arrays["rack"].astype(np.int32))),
+        alive=jnp.asarray(padb(arrays.get("alive", np.ones(b, dtype=bool)), False)),
+        new_broker=jnp.asarray(padb(arrays.get("new_broker", np.zeros(b, dtype=bool)), False)),
+        broker_valid=jnp.asarray(broker_valid),
+        disk_capacity=jnp.asarray(padb(arrays["disk_capacity"].astype(np.float32))),
+        disk_alive=jnp.asarray(padb(arrays["disk_alive"].astype(bool), False)),
+    )
+    placement = Placement(
+        broker=jnp.asarray(padr(arrays["assignment"].astype(np.int32))),
+        disk=jnp.asarray(padr(arrays.get("disk", np.zeros(r, dtype=np.int32)).astype(np.int32))),
+        is_leader=jnp.asarray(padr(arrays["is_leader"].astype(bool))),
+    )
+    return state, placement

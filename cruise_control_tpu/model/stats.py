@@ -1,0 +1,114 @@
+"""Vectorized ClusterModelStats (reference: model/ClusterModelStats.java:29-496).
+
+Per-resource avg/max/min/stdev over alive brokers, balanced-broker counts
+against the balance band, and replica/leader/topic-replica count statistics.
+These feed goal comparators (is the model better after optimization?) and the
+REST responses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource, NUM_RESOURCES
+from cruise_control_tpu.model import ops
+from cruise_control_tpu.model.state import ClusterState, Placement
+
+
+@dataclass
+class ClusterModelStats:
+    """Host-side summary; produced by compute_stats()."""
+
+    avg_util: np.ndarray       # f32[4] mean broker utilization (absolute)
+    max_util: np.ndarray       # f32[4]
+    min_util: np.ndarray       # f32[4]
+    std_util: np.ndarray       # f32[4]
+    num_balanced_brokers: np.ndarray  # i32[4] brokers inside the balance band
+    avg_replicas: float
+    max_replicas: int
+    min_replicas: int
+    std_replicas: float
+    num_brokers: int
+    num_replicas: int
+    num_leaders: int
+    num_unbalanced_brokers: np.ndarray  # i32[4]
+
+    def cv(self) -> np.ndarray:
+        """Coefficient of variation per resource — scale-free balance measure."""
+        return self.std_util / np.maximum(self.avg_util, 1e-9)
+
+    def to_dict(self) -> Dict:
+        return {
+            "statistics": {
+                "AVG": {r.resource: float(self.avg_util[r]) for r in Resource}
+                | {"replicas": self.avg_replicas},
+                "MAX": {r.resource: float(self.max_util[r]) for r in Resource}
+                | {"replicas": self.max_replicas},
+                "MIN": {r.resource: float(self.min_util[r]) for r in Resource}
+                | {"replicas": self.min_replicas},
+                "STD": {r.resource: float(self.std_util[r]) for r in Resource}
+                | {"replicas": self.std_replicas},
+            },
+            "numBalancedBrokers": {r.resource: int(self.num_balanced_brokers[r]) for r in Resource},
+            "numBrokers": self.num_brokers,
+            "numReplicas": self.num_replicas,
+            "numLeaders": self.num_leaders,
+        }
+
+
+def _stats_arrays(state: ClusterState, placement: Placement, balance_threshold: jnp.ndarray):
+    load = ops.broker_load(state, placement)          # [B,4]
+    alive = state.alive & state.broker_valid          # [B]
+    n = jnp.maximum(jnp.sum(alive), 1)
+
+    masked = jnp.where(alive[:, None], load, 0.0)
+    avg = jnp.sum(masked, axis=0) / n
+    mx = jnp.max(jnp.where(alive[:, None], load, -jnp.inf), axis=0)
+    mn = jnp.min(jnp.where(alive[:, None], load, jnp.inf), axis=0)
+    var = jnp.sum(jnp.where(alive[:, None], (load - avg) ** 2, 0.0), axis=0) / n
+    std = jnp.sqrt(var)
+
+    # Balance band per reference ResourceDistributionGoal.initGoalState :236-263:
+    # [avg * (2 - T), avg * T], computed on utilization percentages; equivalently
+    # compare absolute load against avg_util_fraction * capacity bounds.
+    avg_frac = ops.average_alive_utilization(state, placement)      # [4]
+    upper = avg_frac[None, :] * balance_threshold[None, :] * state.capacity
+    lower = avg_frac[None, :] * (2.0 - balance_threshold[None, :]) * state.capacity
+    in_band = (load <= upper) & (load >= lower)
+    balanced = jnp.sum(in_band & alive[:, None], axis=0)
+
+    rc = ops.replica_counts(state, placement)
+    rc_alive = jnp.where(alive, rc, 0)
+    avg_rc = jnp.sum(rc_alive) / n
+    mx_rc = jnp.max(jnp.where(alive, rc, -1))
+    mn_rc = jnp.min(jnp.where(alive, rc, jnp.iinfo(jnp.int32).max))
+    std_rc = jnp.sqrt(jnp.sum(jnp.where(alive, (rc - avg_rc) ** 2, 0.0)) / n)
+
+    num_leaders = jnp.sum((state.valid & placement.is_leader).astype(jnp.int32))
+    num_replicas = jnp.sum(state.valid.astype(jnp.int32))
+    return avg, mx, mn, std, balanced, avg_rc, mx_rc, mn_rc, std_rc, n, num_replicas, num_leaders
+
+
+_stats_jit = jax.jit(_stats_arrays)
+
+
+def compute_stats(state: ClusterState, placement: Placement,
+                  balance_threshold: np.ndarray | None = None) -> ClusterModelStats:
+    if balance_threshold is None:
+        balance_threshold = np.full(NUM_RESOURCES, 1.1, dtype=np.float32)
+    (avg, mx, mn, std, balanced, avg_rc, mx_rc, mn_rc, std_rc, n,
+     num_replicas, num_leaders) = jax.device_get(
+        _stats_jit(state, placement, jnp.asarray(balance_threshold, dtype=jnp.float32)))
+    return ClusterModelStats(
+        avg_util=np.asarray(avg), max_util=np.asarray(mx), min_util=np.asarray(mn),
+        std_util=np.asarray(std), num_balanced_brokers=np.asarray(balanced),
+        avg_replicas=float(avg_rc), max_replicas=int(mx_rc), min_replicas=int(mn_rc),
+        std_replicas=float(std_rc), num_brokers=int(n),
+        num_replicas=int(num_replicas), num_leaders=int(num_leaders),
+        num_unbalanced_brokers=np.asarray(n - balanced, dtype=np.int64),
+    )

@@ -1,0 +1,158 @@
+"""Deterministic test clusters.
+
+Port of the reference fixture generator
+``cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/common/
+DeterministicCluster.java`` (and the constants it pulls from
+``TestConstants.java:40-135``).  These hand-built models drive the analyzer
+parity tests (reference: ``analyzer/DeterministicClusterTest.java``) and are
+BASELINE config #1.
+
+Loads are given as (cpu, nw_in, nw_out, disk) per the reference's
+``getAggregatedMetricValues`` argument order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModel
+
+TYPICAL_CPU_CAPACITY = 100.0
+LARGE_BROKER_CAPACITY = 300_000.0
+MEDIUM_BROKER_CAPACITY = 200_000.0
+SMALL_BROKER_CAPACITY = 10.0
+
+BROKER_CAPACITY = {
+    Resource.CPU: TYPICAL_CPU_CAPACITY,
+    Resource.NW_IN: LARGE_BROKER_CAPACITY,
+    Resource.NW_OUT: MEDIUM_BROKER_CAPACITY,
+    Resource.DISK: LARGE_BROKER_CAPACITY,
+}
+# Two logdirs per broker, half the disk capacity each (TestConstants.DISK_CAPACITY).
+JBOD_DISK_CAPACITIES = [LARGE_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+
+# Broker id -> rack id maps (DeterministicCluster.RACK_BY_BROKER{,2,3}).
+RACK_BY_BROKER = {0: 0, 1: 0, 2: 1}
+RACK_BY_BROKER2 = {0: 0, 1: 1, 2: 1}
+RACK_BY_BROKER3 = {0: 0, 1: 1, 2: 1, 3: 1}
+
+T1, T2 = "T1", "T2"
+
+
+def load(cpu: float, nw_in: float, nw_out: float, disk: float) -> np.ndarray:
+    return np.array([cpu, nw_in, nw_out, disk], dtype=np.float64)
+
+
+def homogeneous_cluster(rack_by_broker: Dict[int, int],
+                        capacity: Optional[Dict[Resource, float]] = None,
+                        jbod: bool = False) -> ClusterModel:
+    """DeterministicCluster.getHomogeneousCluster: one host per broker."""
+    capacity = capacity or BROKER_CAPACITY
+    cm = ClusterModel()
+    for broker_id, rack in sorted(rack_by_broker.items()):
+        cm.create_broker(rack=str(rack), host=f"h{broker_id}", broker_id=broker_id,
+                         capacity=dict(capacity),
+                         disk_capacities=JBOD_DISK_CAPACITIES if jbod else None)
+    return cm
+
+
+def unbalanced() -> ClusterModel:
+    """Two racks, three brokers, two partitions (1 replica each), all on broker 0."""
+    cm = homogeneous_cluster(RACK_BY_BROKER)
+    half = load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic in (T1, T2):
+        cm.create_replica(topic, 0, broker_id=0, index=0, is_leader=True)
+        cm.set_replica_load(topic, 0, 0, half)
+    return cm
+
+
+def unbalanced2() -> ClusterModel:
+    """unbalanced() + four more 1-replica partitions (broker 1 gets one, broker 0 three)."""
+    cm = unbalanced()
+    half = load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic, part, broker in ((T1, 1, 1), (T2, 1, 0), (T1, 2, 0), (T2, 2, 0)):
+        cm.create_replica(topic, part, broker_id=broker, index=0, is_leader=True)
+        cm.set_replica_load(topic, part, broker, half)
+    return cm
+
+
+def unbalanced3() -> ClusterModel:
+    """Two racks, three brokers, two partitions × two replicas; leaders at index 1."""
+    cm = homogeneous_cluster(RACK_BY_BROKER)
+    half = load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic in (T1, T2):
+        cm.create_replica(topic, 0, broker_id=1, index=0, is_leader=False)
+        cm.create_replica(topic, 0, broker_id=0, index=1, is_leader=True)
+        cm.set_replica_load(topic, 0, 0, half)
+        cm.set_replica_load(topic, 0, 1, half)
+    return cm
+
+
+def unbalanced_with_a_follower() -> ClusterModel:
+    """unbalanced() + a follower of T1-0 on broker 2."""
+    cm = unbalanced()
+    cm.create_replica(T1, 0, broker_id=2, index=1, is_leader=False)
+    cm.set_replica_load(T1, 0, 2, load(TYPICAL_CPU_CAPACITY / 8, LARGE_BROKER_CAPACITY / 2,
+                                       0.0, LARGE_BROKER_CAPACITY / 2))
+    return cm
+
+
+def _create_unbalanced(topics, num_partitions: int) -> ClusterModel:
+    """DeterministicCluster.createUnbalanced: 2 brokers / 2 racks / 2 disks each."""
+    cm = homogeneous_cluster({0: 0, 1: 1}, jbod=True)
+    for topic in topics:
+        for i in range(num_partitions):
+            broker_id = 1 if i > 3 else 0
+            logdir = 0 if i % 4 < 2 else 1
+            cm.create_replica(topic, i, broker_id=broker_id, index=0, is_leader=True,
+                              disk=logdir)
+            cm.set_replica_load(topic, i, broker_id, load(
+                TYPICAL_CPU_CAPACITY / 5 + TYPICAL_CPU_CAPACITY / 50 * (i / 2.0 - 1.5),
+                LARGE_BROKER_CAPACITY / 5 + LARGE_BROKER_CAPACITY / 50 * (i / 2.0 - 1.5),
+                MEDIUM_BROKER_CAPACITY / 5 + MEDIUM_BROKER_CAPACITY / 50 * (i / 2.0 - 1.5),
+                LARGE_BROKER_CAPACITY / 5 + LARGE_BROKER_CAPACITY / 50 * (i / 2.0 - 1.5)))
+    return cm
+
+
+def unbalanced4() -> ClusterModel:
+    """Two JBOD brokers on two racks; one topic × 8 single-replica partitions."""
+    return _create_unbalanced((T1,), 8)
+
+
+def unbalanced5() -> ClusterModel:
+    """unbalanced4 shape with two topics × 14 partitions."""
+    return _create_unbalanced((T1, T2), 14)
+
+
+def rack_aware_satisfiable() -> ClusterModel:
+    """Two racks, three brokers, one partition × 2 replicas on brokers 0,1 (same rack)."""
+    cm = homogeneous_cluster(RACK_BY_BROKER)
+    cm.create_replica(T1, 0, broker_id=0, index=0, is_leader=True)
+    cm.create_replica(T1, 0, broker_id=1, index=1, is_leader=False)
+    cm.set_replica_load(T1, 0, 0, load(40.0, 100.0, 130.0, 75.0))
+    cm.set_replica_load(T1, 0, 1, load(5.0, 100.0, 0.0, 75.0))
+    return cm
+
+
+def rack_aware_satisfiable2() -> ClusterModel:
+    """Replicas on brokers 0,2 with RACK_BY_BROKER2 (already rack-aware)."""
+    cm = homogeneous_cluster(RACK_BY_BROKER2)
+    cm.create_replica(T1, 0, broker_id=0, index=0, is_leader=True)
+    cm.create_replica(T1, 0, broker_id=2, index=1, is_leader=False)
+    cm.set_replica_load(T1, 0, 0, load(40.0, 100.0, 130.0, 75.0))
+    cm.set_replica_load(T1, 0, 2, load(5.0, 100.0, 0.0, 75.0))
+    return cm
+
+
+def rack_aware_unsatisfiable() -> ClusterModel:
+    """rack_aware_satisfiable + a third replica: 3 replicas, only 2 racks."""
+    cm = rack_aware_satisfiable()
+    cm.create_replica(T1, 0, broker_id=2, index=2, is_leader=False)
+    cm.set_replica_load(T1, 0, 2, load(60.0, 100.0, 130.0, 75.0))
+    return cm

@@ -1,0 +1,199 @@
+"""Core tensor model tests: builder -> freeze -> ops/stats/sanity.
+
+Mirrors the reference's model-layer invariants (ClusterModel.sanityCheck,
+LoadConsistencyTest) on the SoA representation.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import ops, sanity_check, compute_stats
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.testing import deterministic as det
+
+
+def test_resource_enum_matches_reference():
+    assert Resource.CPU == 0 and Resource.NW_IN == 1
+    assert Resource.NW_OUT == 2 and Resource.DISK == 3
+    assert Resource.CPU.is_host_resource and Resource.CPU.is_broker_resource
+    assert Resource.NW_IN.is_host_resource and not Resource.NW_IN.is_broker_resource
+    assert not Resource.DISK.is_host_resource and Resource.DISK.is_broker_resource
+
+
+def test_unbalanced_freeze_shapes():
+    state, placement, meta = det.unbalanced().freeze()
+    assert meta.num_replicas == 2
+    assert meta.num_brokers == 3
+    assert meta.num_racks == 2
+    assert sanity_check(state, placement, meta) == []
+
+
+def test_broker_load_segment_sum():
+    state, placement, meta = det.unbalanced().freeze()
+    load = np.asarray(ops.broker_load(state, placement))
+    # Both partitions (leaders) on broker 0; each (50, 150000, 100000, 150000).
+    np.testing.assert_allclose(load[0], [100.0, 300000.0, 200000.0, 300000.0], rtol=1e-5)
+    np.testing.assert_allclose(load[1], 0.0)
+    np.testing.assert_allclose(load[2], 0.0)
+
+
+def test_follower_load_derivation():
+    state, placement, meta = det.unbalanced3().freeze()
+    # Followers carry no NW_OUT and a reduced CPU share.
+    eff = np.asarray(ops.effective_load(state, placement))
+    is_leader = np.asarray(placement.is_leader)
+    assert (eff[~is_leader][:, Resource.NW_OUT] == 0).all()
+    assert (eff[~is_leader][:, Resource.CPU] < eff[is_leader][:, Resource.CPU]).all()
+    # Follower NW_IN and DISK equal the leader-role values.
+    np.testing.assert_allclose(eff[~is_leader][:, Resource.NW_IN],
+                               eff[is_leader][:, Resource.NW_IN], rtol=1e-6)
+
+
+def test_leadership_flip_transfers_nw_out():
+    state, placement, meta = det.unbalanced3().freeze()
+    before = np.asarray(ops.broker_load(state, placement))
+    # Flip leadership of both partitions from broker 0 to broker 1 (mask flip only).
+    is_leader = np.asarray(placement.is_leader)
+    new_leader = ~is_leader
+    flipped = placement.replace(is_leader=np.asarray(new_leader))
+    after = np.asarray(ops.broker_load(state, flipped))
+    # NW_OUT moved entirely from broker 0 to broker 1.
+    assert before[0, Resource.NW_OUT] > 0
+    assert after[0, Resource.NW_OUT] == 0
+    np.testing.assert_allclose(after[1, Resource.NW_OUT], before[0, Resource.NW_OUT], rtol=1e-6)
+    # DISK unchanged on both (leadership does not move disk).
+    np.testing.assert_allclose(after[:, Resource.DISK], before[:, Resource.DISK], rtol=1e-6)
+
+
+def test_potential_leadership_load():
+    state, placement, meta = det.unbalanced3().freeze()
+    pot = np.asarray(ops.potential_leadership_load(state, placement))
+    # Each broker holds 2 replicas which would each emit NW_OUT/2 as leader.
+    np.testing.assert_allclose(pot[0], 200000.0, rtol=1e-5)
+    np.testing.assert_allclose(pot[1], 200000.0, rtol=1e-5)
+
+
+def test_counts_and_rack_ops():
+    state, placement, meta = det.rack_aware_unsatisfiable().freeze()
+    rc = np.asarray(ops.replica_counts(state, placement))
+    assert rc[:3].tolist() == [1, 1, 1]
+    same = np.asarray(ops.replicas_on_same_rack(state, placement, meta.num_racks,
+                                                meta.num_partitions))
+    # Brokers 0,1 share rack 0 -> each of those replicas sees one sibling.
+    assert same[:3].tolist() == [1, 1, 0]
+
+    state2, placement2, meta2 = det.rack_aware_satisfiable2().freeze()
+    same2 = np.asarray(ops.replicas_on_same_rack(state2, placement2, meta2.num_racks,
+                                                 meta2.num_partitions))
+    assert (same2[:2] == 0).all()
+
+
+def test_partition_leader_broker():
+    state, placement, meta = det.unbalanced3().freeze()
+    leaders = np.asarray(ops.partition_leader_broker(state, placement, meta.num_partitions))
+    assert (leaders == 0).all()  # broker id 0 leads both partitions
+
+
+def test_disk_load_jbod():
+    state, placement, meta = det.unbalanced4().freeze()
+    assert state.num_disks_per_broker == 2
+    dl = np.asarray(ops.disk_load(state, placement))
+    bl = np.asarray(ops.broker_load(state, placement))
+    np.testing.assert_allclose(dl.sum(axis=1), bl[:, Resource.DISK], rtol=1e-5)
+    assert (dl[:2] > 0).all()  # every logdir of brokers 0,1 holds something
+
+
+def test_sanity_check_catches_duplicates_and_leaderless():
+    cm = det.unbalanced()
+    state, placement, meta = cm.freeze()
+    no_leader = placement.replace(is_leader=np.zeros_like(np.asarray(placement.is_leader)))
+    problems = sanity_check(state, no_leader, meta)
+    assert any("without a leader" in p for p in problems)
+
+    # Two replicas of one partition on the same broker (via a rigged placement).
+    cm2 = det.rack_aware_satisfiable()
+    state2, placement2, meta2 = cm2.freeze()
+    dup = placement2.replace(broker=np.zeros_like(np.asarray(placement2.broker)))
+    problems2 = sanity_check(state2, dup, meta2)
+    assert any(">1 replica on one broker" in p for p in problems2)
+
+
+def test_offline_tracking_with_dead_disk_and_revived_broker():
+    # Dead disk stays offline even after the broker is marked dead then alive.
+    cm = det.unbalanced4()
+    cm.mark_disk_dead(0, 0)
+    cm.set_broker_state(0, alive=False)
+    cm.set_broker_state(0, alive=True)
+    state, placement, meta = cm.freeze()
+    assert np.asarray(state.offline).sum() == 2  # the two logdir-0 replicas
+
+
+def test_rf_reduction_below_one_rejected():
+    cm = det.unbalanced()
+    with pytest.raises(ValueError, match="only the leader remains"):
+        cm.create_or_delete_replicas("T1", target_rf=0)
+
+
+def test_negative_replica_index_rejected():
+    cm = det.unbalanced()
+    with pytest.raises(ValueError, match="index"):
+        cm.create_replica("T1", 5, broker_id=0, index=-1, is_leader=True)
+
+
+def test_stats():
+    state, placement, meta = det.unbalanced().freeze()
+    stats = compute_stats(state, placement)
+    assert stats.num_brokers == 3
+    assert stats.num_replicas == 2
+    assert stats.num_leaders == 2
+    assert stats.max_replicas == 2 and stats.min_replicas == 0
+    # Broker 0 carries everything -> CPU avg is 100/3.
+    np.testing.assert_allclose(stats.avg_util[Resource.CPU], 100.0 / 3, rtol=1e-4)
+    assert stats.num_balanced_brokers[Resource.CPU] == 0  # all out of band
+
+
+def test_mark_disk_dead_and_broker_dead():
+    cm = det.unbalanced4()
+    cm.mark_disk_dead(0, 0)
+    state, placement, meta = cm.freeze()
+    assert np.asarray(state.offline).sum() == 2  # two replicas were on logdir 0 of broker 0
+    problems = sanity_check(state, placement, meta)
+    assert any("dead" in p for p in problems)
+    assert sanity_check(state, placement, meta, allow_offline=True) == []
+
+    cm2 = det.unbalanced()
+    cm2.set_broker_state(0, alive=False)
+    state2, placement2, meta2 = cm2.freeze()
+    assert np.asarray(state2.offline).sum() == 2
+
+
+def test_padding_and_masks():
+    state, placement, meta = det.unbalanced().freeze(pad_replicas_to=16, pad_brokers_to=8)
+    assert state.num_replicas_padded == 16
+    assert state.num_brokers_padded == 8
+    assert np.asarray(state.valid).sum() == 2
+    assert np.asarray(state.broker_valid).sum() == 3
+    # Padded entries contribute nothing.
+    load = np.asarray(ops.broker_load(state, placement))
+    np.testing.assert_allclose(load[3:], 0.0)
+    assert sanity_check(state, placement, meta) == []
+
+
+def test_rf_change():
+    cm = det.unbalanced()
+    cm.create_or_delete_replicas("T1", target_rf=2)
+    state, placement, meta = cm.freeze()
+    assert meta.num_replicas == 3
+    assert sanity_check(state, placement, meta) == []
+
+
+def test_apply_placement_roundtrip():
+    cm = det.unbalanced()
+    state, placement, meta = cm.freeze()
+    moved = placement.replace(broker=np.asarray([1, 2], dtype=np.int32))
+    cm.apply_placement(moved, meta)
+    assert cm.replica("T1", 0, 1).broker_id == 1
+    assert cm.replica("T2", 0, 2).broker_id == 2
+    state2, placement2, meta2 = cm.freeze()
+    assert sanity_check(state2, placement2, meta2) == []
