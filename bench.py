@@ -1,0 +1,66 @@
+"""Benchmark: full multi-goal proposal generation wall-clock.
+
+BASELINE.md config #3: RandomCluster 200 brokers / 50K replicas, full
+hard-goal stack + ResourceDistribution soft goals.  The north-star budget
+(BASELINE.json) is a <10 s full proposal at 2.6K brokers / 1M replicas on one
+v5e chip; this bench reports the 200-broker config so every round has a
+comparable number, with ``vs_baseline`` = north-star-budget / measured (>1 ⇒
+inside budget).  Wall-clock excludes one warmup solve (jit compile is cached
+across snapshots of the same size class in production).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+NORTH_STAR_BUDGET_S = 10.0
+
+GOALS = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "DiskUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+]
+
+
+def main() -> None:
+    from cruise_control_tpu.analyzer import BalancingConstraint, GoalOptimizer
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(
+        num_brokers=200, num_racks=10, num_topics=1000, num_replicas=50_000,
+        mean_cpu=0.006, mean_disk=90.0, mean_nw_in=90.0, mean_nw_out=90.0,
+        seed=3140)
+    state, placement, meta = rc.generate(props)
+
+    constraint = BalancingConstraint()
+    optimizer = GoalOptimizer(constraint=constraint, goal_names=GOALS)
+
+    # Warmup: populates the per-goal jit caches (one compile per goal class).
+    optimizer.optimizations(state, placement, meta)
+
+    t0 = time.monotonic()
+    result = optimizer.optimizations(state, placement, meta)
+    elapsed = time.monotonic() - t0
+
+    print(json.dumps({
+        "metric": "proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
+        "value": round(elapsed, 4),
+        "unit": "seconds",
+        "vs_baseline": round(NORTH_STAR_BUDGET_S / max(elapsed, 1e-9), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

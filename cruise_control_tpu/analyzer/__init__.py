@@ -1,0 +1,18 @@
+"""Analyzer: goals, solver kernels, optimizer orchestration.
+
+TPU-native replacement for the reference analyzer
+(``analyzer/GoalOptimizer.java``, ``analyzer/goals/*``): goal semantics become
+mask/cost kernels over the SoA cluster tensors, and the per-broker greedy
+search becomes batched rounds of score → mask → argmin → scan-apply.
+"""
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.options import OptimizationOptions
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
+
+__all__ = [
+    "BalancingConstraint",
+    "OptimizationOptions",
+    "GoalOptimizer",
+    "OptimizerResult",
+]

@@ -1,0 +1,434 @@
+"""Traced optimization context and incremental aggregates.
+
+The reference mutates a ``ClusterModel`` object graph and pushes load deltas up
+the replica→broker→host→rack tree on every action
+(``model/ClusterModel.java:375-434``).  Here the same bookkeeping is a small
+set of dense arrays (``Aggregates``) carried through a ``lax.scan``: applying a
+move is a handful of scatter-adds, and every goal predicate is a broadcastable
+function of (context, aggregates, replica-index, destination) usable both for
+the batched C×B feasibility matrices and for the scalar re-check at apply time.
+
+Key structural trick: partition membership never changes during optimization,
+so ``partition_replicas: i32[P, RF_max]`` (replica rows per partition, -1 pad)
+is precomputed once per snapshot.  "Does broker b already hold partition p" is
+then an RF-wide gather instead of a P×B matrix — the reason this scales to
+1M replicas × 2.6K brokers without materializing replica×broker state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.options import OptimizationOptions
+from cruise_control_tpu.common.resources import (
+    IS_BROKER_RESOURCE,
+    IS_HOST_RESOURCE,
+    NUM_RESOURCES,
+    Resource,
+)
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
+
+NEG_INF = -jnp.inf
+
+
+@flax.struct.dataclass
+class GoalContext:
+    """Per-optimization constants (traced, but never change across rounds)."""
+
+    state: ClusterState
+    partition_replicas: jnp.ndarray       # i32[P, RF_max], -1 padded
+    host_capacity: jnp.ndarray            # f32[H, 4] sum of alive member broker capacity
+    balance_threshold: jnp.ndarray        # f32[4] (>= 1)
+    capacity_threshold: jnp.ndarray       # f32[4] (<= 1)
+    low_utilization_threshold: jnp.ndarray  # f32[4]
+    max_replicas_per_broker: jnp.ndarray  # i32 scalar
+    excluded_topics: jnp.ndarray          # bool[T]
+    excluded_for_leadership: jnp.ndarray  # bool[B]
+    excluded_for_replica_move: jnp.ndarray  # bool[B]
+    requested_dst: jnp.ndarray            # bool[B]
+    only_move_immigrants: jnp.ndarray     # bool scalar
+    # Per-replica precomputed masks.
+    replica_excluded: jnp.ndarray         # bool[R]: topic excluded
+    # ReplicaDistribution/TopicReplicaDistribution numeric knobs.
+    replica_balance_threshold: jnp.ndarray         # f32 scalar
+    leader_replica_balance_threshold: jnp.ndarray  # f32 scalar
+    topic_replica_balance_threshold: jnp.ndarray   # f32 scalar
+    topic_replica_balance_min_gap: jnp.ndarray     # i32 scalar
+    min_topic_leaders: jnp.ndarray                 # i32 scalar
+    min_leader_topic_mask: jnp.ndarray             # bool[T] topics subject to MinTopicLeaders
+    num_racks: int = flax.struct.field(pytree_node=False, default=1)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partition_replicas.shape[0]
+
+    @property
+    def max_rf(self) -> int:
+        return self.partition_replicas.shape[1]
+
+    @property
+    def num_hosts(self) -> int:
+        return self.host_capacity.shape[0]
+
+    @property
+    def num_topics(self) -> int:
+        return self.excluded_topics.shape[0]
+
+
+@flax.struct.dataclass
+class Aggregates:
+    """Incrementally-maintained cluster aggregates (the scan carry).
+
+    Everything a goal predicate needs at apply time, kept O(B)+O(H)+O(T·B)
+    so per-move updates are scatter-adds, never O(R) recomputes.
+    """
+
+    broker_load: jnp.ndarray      # f32[B, 4]
+    host_load: jnp.ndarray        # f32[H, 4]
+    replica_counts: jnp.ndarray   # i32[B]
+    leader_counts: jnp.ndarray    # i32[B]
+    topic_counts: jnp.ndarray     # i32[T, B]
+    topic_leader_counts: jnp.ndarray  # i32[T, B]
+    disk_load: jnp.ndarray        # f32[B, D]
+    potential_nw_out: jnp.ndarray  # f32[B]
+    leader_bytes_in: jnp.ndarray  # f32[B]
+
+
+def _pad2(n: int, floor: int = 8) -> int:
+    """Round up to a power-of-two size class (min ``floor``) so jitted kernels
+    recompile only when a dimension crosses a size class, not on every
+    snapshot (brokers die, partitions appear)."""
+    n = max(n, 1)
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def build_context(
+    state: ClusterState,
+    placement: Placement,
+    meta: ClusterMeta,
+    constraint: BalancingConstraint,
+    options: OptimizationOptions,
+) -> GoalContext:
+    """Host-side packing of constraint/option tensors for one optimization."""
+    b_pad = state.num_brokers_padded
+
+    # partition_replicas from the (host-visible) partition array.
+    part = np.asarray(state.partition)
+    valid = np.asarray(state.valid)
+    num_p = _pad2(meta.num_partitions)
+    order = np.argsort(part[valid], kind="stable")
+    valid_idx = np.nonzero(valid)[0][order]
+    max_rf = 1
+    if valid_idx.size:
+        counts = np.bincount(part[valid_idx], minlength=num_p)
+        max_rf = max(int(counts.max()), 1)
+    max_rf = _pad2(max_rf, floor=2)
+    pr = np.full((num_p, max_rf), -1, dtype=np.int64)
+    slot = np.zeros(len(valid_idx), dtype=np.int64)
+    # Slot within partition = running index among same-partition rows
+    # (valid_idx is sorted by partition, stable).
+    pp = part[valid_idx]
+    if len(pp):
+        firsts = np.searchsorted(pp, pp, side="left")
+        slot = np.arange(len(pp)) - firsts
+        pr[pp, slot] = valid_idx
+
+    # Host capacity: sum of alive member brokers' capacity.
+    host = np.asarray(state.host)
+    alive = np.asarray(state.alive) & np.asarray(state.broker_valid)
+    cap = np.asarray(state.capacity)
+    num_h = _pad2(meta.num_hosts)
+    host_cap = np.zeros((num_h, NUM_RESOURCES), dtype=np.float32)
+    np.add.at(host_cap, host[alive], cap[alive])
+
+    num_t = _pad2(meta.num_topics)
+    excluded_topics = np.zeros(num_t, dtype=bool)
+    excluded_topics[:meta.num_topics] = options.excluded_topic_mask(meta)
+    topic_arr = np.asarray(state.topic)
+    replica_excluded = excluded_topics[np.clip(topic_arr, 0, num_t - 1)]
+    replica_excluded = replica_excluded & valid
+
+    min_leader_topics = np.zeros(num_t, dtype=bool)
+    for i, t in enumerate(meta.topics):
+        if t in constraint.min_leader_topic_names:
+            min_leader_topics[i] = True
+
+    return GoalContext(
+        state=state,
+        partition_replicas=jnp.asarray(pr, dtype=jnp.int32),
+        host_capacity=jnp.asarray(host_cap),
+        balance_threshold=jnp.asarray(
+            constraint.balance_band(options.is_triggered_by_goal_violation)),
+        capacity_threshold=jnp.asarray(constraint.capacity_threshold, dtype=jnp.float32),
+        low_utilization_threshold=jnp.asarray(
+            constraint.low_utilization_threshold, dtype=jnp.float32),
+        max_replicas_per_broker=jnp.asarray(constraint.max_replicas_per_broker, dtype=jnp.int32),
+        excluded_topics=jnp.asarray(excluded_topics),
+        excluded_for_leadership=jnp.asarray(options.leadership_exclusion_mask(meta, b_pad)),
+        excluded_for_replica_move=jnp.asarray(options.replica_move_exclusion_mask(meta, b_pad)),
+        requested_dst=jnp.asarray(options.destination_mask(meta, b_pad)),
+        only_move_immigrants=jnp.asarray(options.only_move_immigrant_replicas),
+        replica_excluded=jnp.asarray(replica_excluded),
+        replica_balance_threshold=jnp.asarray(constraint.replica_balance_threshold,
+                                              dtype=jnp.float32),
+        leader_replica_balance_threshold=jnp.asarray(
+            constraint.leader_replica_balance_threshold, dtype=jnp.float32),
+        topic_replica_balance_threshold=jnp.asarray(
+            constraint.topic_replica_balance_threshold, dtype=jnp.float32),
+        topic_replica_balance_min_gap=jnp.asarray(
+            constraint.topic_replica_balance_min_gap, dtype=jnp.int32),
+        min_topic_leaders=jnp.asarray(constraint.min_topic_leaders_per_broker, dtype=jnp.int32),
+        min_leader_topic_mask=jnp.asarray(min_leader_topics),
+        num_racks=_pad2(meta.num_racks),
+    )
+
+
+# --------------------------------------------------------------------- loads
+
+
+def replica_role_load(gctx: GoalContext, placement: Placement, r) -> jnp.ndarray:
+    """f32[..., 4]: effective load of replica r in its current role."""
+    lead = gctx.state.leader_load[r]
+    foll = gctx.state.follower_load[r]
+    return jnp.where(placement.is_leader[r][..., None], lead, foll)
+
+
+def compute_aggregates(gctx: GoalContext, placement: Placement) -> Aggregates:
+    """Full recompute (round boundaries); scans update incrementally."""
+    state = gctx.state
+    b = state.num_brokers_padded
+    t = gctx.num_topics
+    load = jnp.where(placement.is_leader[:, None], state.leader_load, state.follower_load)
+    load = load * state.valid[:, None]
+    broker_load = jax.ops.segment_sum(load, placement.broker, num_segments=b)
+    host_load = jax.ops.segment_sum(broker_load, state.host, num_segments=gctx.num_hosts)
+    valid_i = state.valid.astype(jnp.int32)
+    leader_i = (state.valid & placement.is_leader).astype(jnp.int32)
+    replica_counts = jax.ops.segment_sum(valid_i, placement.broker, num_segments=b)
+    leader_counts = jax.ops.segment_sum(leader_i, placement.broker, num_segments=b)
+    flat = state.topic * b + placement.broker
+    topic_counts = jax.ops.segment_sum(valid_i, flat, num_segments=t * b).reshape(t, b)
+    topic_leader_counts = jax.ops.segment_sum(leader_i, flat, num_segments=t * b).reshape(t, b)
+    dflat = placement.broker * state.num_disks_per_broker + placement.disk
+    disk_load = jax.ops.segment_sum(
+        load[:, Resource.DISK], dflat,
+        num_segments=b * state.num_disks_per_broker,
+    ).reshape(b, state.num_disks_per_broker)
+    potential = jax.ops.segment_sum(
+        state.leader_load[:, Resource.NW_OUT] * state.valid, placement.broker, num_segments=b)
+    leader_bytes_in = jax.ops.segment_sum(
+        state.leader_load[:, Resource.NW_IN] * leader_i.astype(jnp.float32),
+        placement.broker, num_segments=b)
+    return Aggregates(
+        broker_load=broker_load, host_load=host_load,
+        replica_counts=replica_counts, leader_counts=leader_counts,
+        topic_counts=topic_counts, topic_leader_counts=topic_leader_counts,
+        disk_load=disk_load, potential_nw_out=potential,
+        leader_bytes_in=leader_bytes_in,
+    )
+
+
+def currently_offline(gctx: GoalContext, placement: Placement, r=None):
+    """bool: replica sits on a dead broker or dead logdir *under the current
+    placement* (unlike ``state.offline``, which is snapshot-time truth —
+    replicas already moved to a live broker are no longer offline)."""
+    state = gctx.state
+    if r is None:
+        b = placement.broker
+        return state.valid & (~state.alive[b] | ~state.disk_alive[b, placement.disk])
+    r = jnp.asarray(r)
+    b = placement.broker[r]
+    return state.valid[r] & (~state.alive[b] | ~state.disk_alive[b, placement.disk[r]])
+
+
+# ----------------------------------------------------------- move application
+
+
+def apply_replica_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
+                       r, dst, dst_disk):
+    """Apply inter-broker move of replica r to (dst, dst_disk); returns new
+    (placement, agg).  All scalar scatter updates — the lax.scan step body."""
+    state = gctx.state
+    src = placement.broker[r]
+    src_disk = placement.disk[r]
+    load = replica_role_load(gctx, placement, r)
+    is_lead = placement.is_leader[r]
+    topic = state.topic[r]
+    pot = state.leader_load[r, Resource.NW_OUT]
+    lbi = jnp.where(is_lead, state.leader_load[r, Resource.NW_IN], 0.0)
+
+    broker_load = agg.broker_load.at[src].add(-load).at[dst].add(load)
+    host_load = agg.host_load.at[state.host[src]].add(-load).at[state.host[dst]].add(load)
+    replica_counts = agg.replica_counts.at[src].add(-1).at[dst].add(1)
+    inc = is_lead.astype(jnp.int32)
+    leader_counts = agg.leader_counts.at[src].add(-inc).at[dst].add(inc)
+    topic_counts = agg.topic_counts.at[topic, src].add(-1).at[topic, dst].add(1)
+    topic_leader_counts = (agg.topic_leader_counts.at[topic, src].add(-inc)
+                           .at[topic, dst].add(inc))
+    disk_load = (agg.disk_load.at[src, src_disk].add(-load[Resource.DISK])
+                 .at[dst, dst_disk].add(load[Resource.DISK]))
+    potential = agg.potential_nw_out.at[src].add(-pot).at[dst].add(pot)
+    leader_bytes_in = agg.leader_bytes_in.at[src].add(-lbi).at[dst].add(lbi)
+
+    placement = placement.replace(
+        broker=placement.broker.at[r].set(dst),
+        disk=placement.disk.at[r].set(dst_disk),
+    )
+    agg = Aggregates(
+        broker_load=broker_load, host_load=host_load,
+        replica_counts=replica_counts, leader_counts=leader_counts,
+        topic_counts=topic_counts, topic_leader_counts=topic_leader_counts,
+        disk_load=disk_load, potential_nw_out=potential,
+        leader_bytes_in=leader_bytes_in,
+    )
+    return placement, agg
+
+
+def apply_intra_disk_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
+                          r, dst_disk):
+    """Move replica r to another logdir of its own broker (JBOD)."""
+    b = placement.broker[r]
+    size = gctx.state.leader_load[r, Resource.DISK]
+    disk_load = (agg.disk_load.at[b, placement.disk[r]].add(-size)
+                 .at[b, dst_disk].add(size))
+    placement = placement.replace(disk=placement.disk.at[r].set(dst_disk))
+    return placement, agg.replace(disk_load=disk_load)
+
+
+def current_leader_of(gctx: GoalContext, placement: Placement, p):
+    """i32[...]: replica row of partition p's current leader (-1 if none).
+    Shape-polymorphic: p may be scalar or batched."""
+    sibs = gctx.partition_replicas[jnp.asarray(p)]         # [..., RF]
+    ok = (sibs >= 0) & placement.is_leader[jnp.maximum(sibs, 0)]
+    any_leader = jnp.any(ok, axis=-1)
+    idx = jnp.argmax(ok, axis=-1)
+    got = jnp.take_along_axis(sibs, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(any_leader, got, -1)
+
+
+def apply_leadership_move(gctx: GoalContext, placement: Placement, agg: Aggregates, f):
+    """Promote follower replica f to leader (demoting the current leader).
+
+    Load semantics per ``ClusterModel.relocateLeadership`` :402-434: the old
+    leader keeps only its follower-role load; the new leader takes leader-role
+    load — here realised by flipping the is_leader mask and applying the two
+    role-load deltas.
+    """
+    state = gctx.state
+    p = state.partition[f]
+    old = current_leader_of(gctx, placement, p)
+    old_safe = jnp.maximum(old, 0)
+    has_old = old >= 0
+
+    f_b = placement.broker[f]
+    o_b = placement.broker[old_safe]
+    d_new = state.leader_load[f] - state.follower_load[f]       # gained at f's broker
+    d_old = jnp.where(has_old,
+                      state.follower_load[old_safe] - state.leader_load[old_safe],
+                      jnp.zeros_like(d_new))                    # lost at old broker
+
+    broker_load = agg.broker_load.at[f_b].add(d_new).at[o_b].add(d_old)
+    host_load = (agg.host_load.at[state.host[f_b]].add(d_new)
+                 .at[state.host[o_b]].add(d_old))
+    dec = has_old.astype(jnp.int32)
+    leader_counts = agg.leader_counts.at[f_b].add(1).at[o_b].add(-dec)
+    topic = state.topic[f]
+    topic_leader_counts = (agg.topic_leader_counts.at[topic, f_b].add(1)
+                           .at[topic, o_b].add(-dec))
+    disk_load = (agg.disk_load.at[f_b, placement.disk[f]].add(d_new[Resource.DISK])
+                 .at[o_b, placement.disk[old_safe]].add(d_old[Resource.DISK]))
+    leader_bytes_in = (agg.leader_bytes_in.at[f_b].add(state.leader_load[f, Resource.NW_IN])
+                       .at[o_b].add(jnp.where(
+                           has_old, -state.leader_load[old_safe, Resource.NW_IN], 0.0)))
+
+    is_leader = placement.is_leader.at[f].set(True)
+    is_leader = jnp.where(has_old, is_leader.at[old_safe].set(False), is_leader)
+    placement = placement.replace(is_leader=is_leader)
+    agg = agg.replace(
+        broker_load=broker_load, host_load=host_load, leader_counts=leader_counts,
+        topic_leader_counts=topic_leader_counts, disk_load=disk_load,
+        leader_bytes_in=leader_bytes_in,
+    )
+    return placement, agg
+
+
+# --------------------------------------------------------- base feasibility
+
+
+def sibling_on_broker(gctx: GoalContext, placement: Placement, r, b):
+    """bool[...]: does broker b already hold another replica of r's partition.
+
+    r, b broadcast (e.g. r:[C,1], b:[1,B] for the feasibility matrix;
+    scalars at scan time).  RF-wide gather, never P×B.
+    """
+    r = jnp.asarray(r)
+    b = jnp.asarray(b)
+    p = gctx.state.partition[r]                      # [...]
+    sibs = gctx.partition_replicas[p]                # [..., RF]
+    sib_b = placement.broker[jnp.maximum(sibs, 0)]   # [..., RF]
+    is_sib = (sibs >= 0) & (sibs != r[..., None])
+    return jnp.any(is_sib & (sib_b == b[..., None]), axis=-1)
+
+
+def base_replica_move_ok(gctx: GoalContext, placement: Placement, r, dst):
+    """The ``legitMove`` equivalent (GoalUtils): structural feasibility of
+    moving replica r to broker dst, independent of any goal."""
+    state = gctx.state
+    r = jnp.asarray(r)
+    dst = jnp.asarray(dst)
+    src = placement.broker[r]
+    dst_ok = (state.alive[dst] & state.broker_valid[dst]
+              & ~gctx.excluded_for_replica_move[dst]
+              & gctx.requested_dst[dst]
+              & jnp.any(state.disk_alive[dst], axis=-1))
+    offline = currently_offline(gctx, placement, r)
+    r_ok = state.valid[r] & ~gctx.replica_excluded[r]
+    immigrant = (src != state.orig_broker[r]) | offline
+    r_ok = r_ok & (~gctx.only_move_immigrants | immigrant)
+    # Excluded-topic replicas still must leave dead brokers (reference
+    # GoalUtils: offline replicas of excluded topics are movable).
+    r_ok = r_ok | offline
+    return (r_ok & dst_ok & (dst != src)
+            & ~sibling_on_broker(gctx, placement, r, dst))
+
+
+def base_leadership_ok(gctx: GoalContext, placement: Placement, f):
+    """Can follower f be promoted to leader (structurally)."""
+    state = gctx.state
+    f = jnp.asarray(f)
+    b = placement.broker[f]
+    return (state.valid[f] & ~placement.is_leader[f] & ~state.offline[f]
+            & state.alive[b] & ~gctx.excluded_for_leadership[b]
+            & ~gctx.replica_excluded[f])
+
+
+def capacity_limit(gctx: GoalContext, b) -> jnp.ndarray:
+    """f32[..., 4]: broker b's hard capacity limit (threshold * capacity)."""
+    return gctx.capacity_threshold * gctx.state.capacity[b]
+
+
+def within_capacity_after_move(gctx: GoalContext, agg: Aggregates, placement: Placement,
+                               r, dst):
+    """bool: dst (broker + host scoped resources) stays under the hard
+    capacity threshold after receiving replica r (CapacityGoal semantics)."""
+    state = gctx.state
+    load = replica_role_load(gctx, placement, r)                 # [...,4]
+    b_after = agg.broker_load[dst] + load
+    b_ok = b_after <= capacity_limit(gctx, dst)
+    h = state.host[dst]
+    same_host = (state.host[placement.broker[r]] == h)           # no host-level delta
+    h_after = agg.host_load[h] + load * (~same_host[..., None])
+    h_ok = h_after <= gctx.capacity_threshold * gctx.host_capacity[h]
+    is_host = jnp.asarray(IS_HOST_RESOURCE)
+    is_broker = jnp.asarray(IS_BROKER_RESOURCE)
+    ok = jnp.where(is_broker, b_ok, True) & jnp.where(is_host, h_ok, True)
+    return jnp.all(ok, axis=-1)

@@ -1,0 +1,25 @@
+"""Goal implementations.
+
+Each reference goal class (``analyzer/goals/*.java``) maps to one Goal object
+here exposing mask/cost kernels instead of a per-broker greedy loop; the
+solver (``analyzer/solver.py``) provides the shared search skeleton the way
+``AbstractGoal.optimize`` does for the reference.
+"""
+
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.registry import (
+    DEFAULT_GOALS,
+    DEFAULT_HARD_GOALS,
+    DEFAULT_ANOMALY_DETECTION_GOALS,
+    get_goals_by_priority,
+    goal_by_name,
+)
+
+__all__ = [
+    "Goal",
+    "DEFAULT_GOALS",
+    "DEFAULT_HARD_GOALS",
+    "DEFAULT_ANOMALY_DETECTION_GOALS",
+    "get_goals_by_priority",
+    "goal_by_name",
+]

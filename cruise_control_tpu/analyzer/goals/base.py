@@ -1,0 +1,158 @@
+"""Goal SPI: each goal is a set of pure, broadcastable kernels.
+
+Reference contract: ``analyzer/goals/Goal.java:39-156`` (optimize /
+actionAcceptance / ClusterModelStatsComparator / isHardGoal) and the
+``AbstractGoal.optimize`` template (AbstractGoal.java:78-130).  The object-
+oriented template method becomes data: a goal supplies
+
+- ``violated_brokers``            — which brokers still need work (bool[B]);
+- ``candidate_score``             — which replicas to move, in what order (f32[R]);
+- ``self_ok`` / ``dst_cost``      — per-(replica, destination) feasibility and
+                                    preference, broadcastable to C×B;
+- ``accept_replica_move`` / ``accept_leadership_move`` — the actionAcceptance
+  veto this goal exercises over *later* goals' actions;
+- ``stats_metric``                — scalar "lower is better" for the
+                                    ClusterModelStatsComparator post-check.
+
+All kernels take (gctx, placement, agg) plus broadcast index arguments, carry
+no Python state, and are shape-polymorphic: the same function evaluates a
+C×B feasibility matrix during batched scoring and a scalar re-check inside the
+apply scan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    currently_offline,
+    replica_role_load,
+)
+from cruise_control_tpu.model.state import Placement
+
+NEG_INF = -jnp.inf
+# Offline replicas (dead broker / dead disk) are moved before anything else —
+# the reference does this at the top of every goal's optimize().
+OFFLINE_BONUS = 1e30
+
+
+class Goal:
+    """Base goal: permissive defaults; subclasses override what they constrain."""
+
+    name: str = "Goal"
+    is_hard: bool = False
+    uses_replica_moves: bool = True
+    uses_leadership_moves: bool = False
+    has_pull_phase: bool = False
+
+    def key(self) -> str:
+        """Jit-cache key; goals with numeric config should include it here."""
+        return self.name
+
+    # ---------------------------------------------------------------- rounds
+
+    def violated_brokers(self, gctx: GoalContext, placement: Placement,
+                         agg: Aggregates) -> jnp.ndarray:
+        return jnp.zeros(gctx.state.num_brokers_padded, dtype=bool)
+
+    def candidate_score(self, gctx: GoalContext, placement: Placement,
+                        agg: Aggregates) -> jnp.ndarray:
+        """f32[R]: -inf = not a candidate; higher = move first."""
+        return self.score_on_violated(gctx, placement, agg,
+                                      self.replica_priority(gctx, placement, agg))
+
+    def replica_priority(self, gctx: GoalContext, placement: Placement,
+                         agg: Aggregates) -> jnp.ndarray:
+        """Default ordering: heaviest replicas first (total effective load)."""
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return jnp.sum(load / jnp.maximum(jnp.mean(
+            gctx.state.capacity, axis=0, keepdims=True), 1e-9), axis=-1)
+
+    def score_on_violated(self, gctx: GoalContext, placement: Placement,
+                          agg: Aggregates, priority: jnp.ndarray) -> jnp.ndarray:
+        """Candidates = valid replicas on violated brokers, plus offline
+        replicas (with a bonus so they are handled first)."""
+        state = gctx.state
+        vb = self.violated_brokers(gctx, placement, agg)
+        on_violated = vb[placement.broker] & state.valid & ~gctx.replica_excluded
+        score = jnp.where(on_violated, priority, NEG_INF)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, priority + OFFLINE_BONUS, score)
+
+    # ------------------------------------------------- replica-move kernels
+
+    def self_ok(self, gctx: GoalContext, placement: Placement, agg: Aggregates,
+                r, dst):
+        """Would moving replica r to dst satisfy/improve THIS goal."""
+        return jnp.broadcast_to(jnp.asarray(True), jnp.broadcast_shapes(
+            jnp.shape(r), jnp.shape(dst)))
+
+    def dst_cost(self, gctx: GoalContext, placement: Placement, agg: Aggregates,
+                 r, dst):
+        """Lower = preferred destination. Default: emptiest broker after move."""
+        load = replica_role_load(gctx, placement, r)
+        after = agg.broker_load[dst] + load
+        frac = after / jnp.maximum(gctx.state.capacity[dst], 1e-9)
+        return jnp.sum(frac, axis=-1)
+
+    def accept_replica_move(self, gctx: GoalContext, placement: Placement,
+                            agg: Aggregates, r, dst):
+        """actionAcceptance for later goals' replica moves (True = ACCEPT)."""
+        return jnp.broadcast_to(jnp.asarray(True), jnp.broadcast_shapes(
+            jnp.shape(r), jnp.shape(dst)))
+
+    # -------------------------------------------------- leadership kernels
+
+    def leadership_candidate_score(self, gctx: GoalContext, placement: Placement,
+                                   agg: Aggregates) -> jnp.ndarray:
+        """f32[R] over FOLLOWER replicas: promote which, in what order."""
+        return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
+
+    def leadership_self_ok(self, gctx: GoalContext, placement: Placement,
+                           agg: Aggregates, f):
+        return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+
+    def accept_leadership_move(self, gctx: GoalContext, placement: Placement,
+                               agg: Aggregates, f):
+        """actionAcceptance for later goals' leadership promotions."""
+        return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+
+    # ------------------------------------------------------ pull (move-in)
+
+    def pull_dst_mask(self, gctx: GoalContext, placement: Placement,
+                      agg: Aggregates) -> jnp.ndarray:
+        """bool[B]: brokers that need load moved IN (e.g. empty new brokers)."""
+        return jnp.zeros(gctx.state.num_brokers_padded, dtype=bool)
+
+    def pull_candidate_score(self, gctx: GoalContext, placement: Placement,
+                             agg: Aggregates) -> jnp.ndarray:
+        return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
+
+    # ------------------------------------------------------------- metrics
+
+    def stats_metric(self, gctx: GoalContext, placement: Placement,
+                     agg: Aggregates):
+        """Scalar, lower = better (ClusterModelStatsComparator equivalent)."""
+        return jnp.sum(self.violated_brokers(gctx, placement, agg).astype(jnp.float32))
+
+    def __repr__(self) -> str:
+        return f"<{self.name} hard={self.is_hard}>"
+
+
+def alive_mask(gctx: GoalContext) -> jnp.ndarray:
+    return gctx.state.alive & gctx.state.broker_valid
+
+
+def broker_util(gctx: GoalContext, agg: Aggregates, resource: int) -> jnp.ndarray:
+    """f32[B]: absolute load for one resource (capacity-relative forms divide)."""
+    return agg.broker_load[:, resource]
+
+
+def avg_alive_util_fraction(gctx: GoalContext, agg: Aggregates, resource: int):
+    alive = alive_mask(gctx)
+    total = jnp.sum(jnp.where(alive, agg.broker_load[:, resource], 0.0))
+    cap = jnp.sum(jnp.where(alive, gctx.state.capacity[:, resource], 0.0))
+    return total / jnp.maximum(cap, 1e-9)

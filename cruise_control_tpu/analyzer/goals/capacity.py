@@ -1,0 +1,197 @@
+"""Hard capacity goals.
+
+Reference: ``analyzer/goals/CapacityGoal.java:40-466`` (+ the four resource
+subclasses), ``ReplicaCapacityGoal.java``, ``IntraBrokerDiskCapacityGoal.java``.
+
+A broker (and, for host-scoped resources, its host) must stay under
+``capacity_threshold[res] * capacity``.  As kernels: violation = util over
+limit; self_ok = destination stays under limit after the move; acceptance =
+identical predicate applied to later goals' actions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    replica_role_load,
+)
+from cruise_control_tpu.analyzer.goals.base import Goal, NEG_INF, alive_mask
+from cruise_control_tpu.common.resources import IS_HOST_RESOURCE, Resource
+from cruise_control_tpu.model.state import Placement
+
+
+class CapacityGoal(Goal):
+    """One resource's hard utilization cap (CapacityGoal.java:40-466)."""
+
+    is_hard = True
+    resource: int = Resource.DISK
+
+    def __init__(self, resource: int, name: str):
+        self.resource = int(resource)
+        self.name = name
+
+    def _limit(self, gctx: GoalContext, b):
+        return gctx.capacity_threshold[self.resource] * gctx.state.capacity[b, self.resource]
+
+    def _host_limit(self, gctx: GoalContext, h):
+        return gctx.capacity_threshold[self.resource] * gctx.host_capacity[h, self.resource]
+
+    def violated_brokers(self, gctx, placement, agg):
+        res = self.resource
+        over = agg.broker_load[:, res] > self._limit(gctx, jnp.arange(
+            gctx.state.num_brokers_padded))
+        if IS_HOST_RESOURCE[res]:
+            host_over = agg.host_load[:, res] > (
+                gctx.capacity_threshold[res] * gctx.host_capacity[:, res])
+            over = over | host_over[gctx.state.host]
+        return over & alive_mask(gctx)
+
+    def replica_priority(self, gctx, placement, agg):
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return load[:, self.resource]
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        res = self.resource
+        load = replica_role_load(gctx, placement, r)[..., res]
+        b_ok = agg.broker_load[dst, res] + load <= self._limit(gctx, dst)
+        if not IS_HOST_RESOURCE[res]:
+            return b_ok
+        h = gctx.state.host[dst]
+        same_host = gctx.state.host[placement.broker[r]] == h
+        h_after = agg.host_load[h, res] + load * (~same_host)
+        return b_ok & (h_after <= self._host_limit(gctx, h))
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        """Promotion shifts load onto f's broker for CPU/NW_OUT."""
+        res = self.resource
+        if res not in (Resource.CPU, Resource.NW_OUT):
+            return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+        delta = (gctx.state.leader_load[f, res] - gctx.state.follower_load[f, res])
+        b = placement.broker[f]
+        b_ok = agg.broker_load[b, res] + delta <= self._limit(gctx, b)
+        h = gctx.state.host[b]
+        h_ok = agg.host_load[h, res] + delta <= self._host_limit(gctx, h)
+        return b_ok & h_ok
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        res = self.resource
+        load = replica_role_load(gctx, placement, r)[..., res]
+        after = agg.broker_load[dst, res] + load
+        return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
+
+    def stats_metric(self, gctx, placement, agg):
+        """Total over-limit load (lower better, 0 == satisfied)."""
+        res = self.resource
+        limit = gctx.capacity_threshold[res] * gctx.state.capacity[:, res]
+        excess = jnp.maximum(agg.broker_load[:, res] - limit, 0.0)
+        return jnp.sum(jnp.where(alive_mask(gctx), excess, 0.0))
+
+
+class CpuCapacityGoal(CapacityGoal):
+    def __init__(self):
+        super().__init__(Resource.CPU, "CpuCapacityGoal")
+
+
+class NetworkInboundCapacityGoal(CapacityGoal):
+    def __init__(self):
+        super().__init__(Resource.NW_IN, "NetworkInboundCapacityGoal")
+
+
+class NetworkOutboundCapacityGoal(CapacityGoal):
+    def __init__(self):
+        super().__init__(Resource.NW_OUT, "NetworkOutboundCapacityGoal")
+
+
+class DiskCapacityGoal(CapacityGoal):
+    def __init__(self):
+        super().__init__(Resource.DISK, "DiskCapacityGoal")
+
+
+class ReplicaCapacityGoal(Goal):
+    """Max replicas per broker (ReplicaCapacityGoal.java).
+
+    Dead brokers are violated by definition (their replicas must vacate);
+    alive brokers by count > ``max_replicas_per_broker``.
+    """
+
+    name = "ReplicaCapacityGoal"
+    is_hard = True
+
+    def violated_brokers(self, gctx, placement, agg):
+        alive = alive_mask(gctx)
+        over = agg.replica_counts > gctx.max_replicas_per_broker
+        dead_with_replicas = (~gctx.state.alive) & gctx.state.broker_valid & (
+            agg.replica_counts > 0)
+        return (over & alive) | dead_with_replicas
+
+    def replica_priority(self, gctx, placement, agg):
+        # Light replicas first: vacating over-count brokers moves minimal load.
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return -jnp.sum(load, axis=-1)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        del r
+        return agg.replica_counts[dst] + 1 <= gctx.max_replicas_per_broker
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        del r
+        return agg.replica_counts[dst].astype(jnp.float32)
+
+    def stats_metric(self, gctx, placement, agg):
+        over = jnp.maximum(agg.replica_counts - gctx.max_replicas_per_broker, 0)
+        return jnp.sum(jnp.where(alive_mask(gctx), over, 0)).astype(jnp.float32)
+
+
+class IntraBrokerDiskCapacityGoal(Goal):
+    """Per-logdir capacity inside JBOD brokers (IntraBrokerDiskCapacityGoal.java).
+
+    Uses intra-broker disk moves: violation = disk load over
+    ``capacity_threshold[DISK] * disk_capacity``; fix = move replicas to a
+    sibling disk with headroom.  Solved by the solver's intra-disk phase.
+    """
+
+    name = "IntraBrokerDiskCapacityGoal"
+    is_hard = True
+    uses_replica_moves = False
+    intra_disk = True
+
+    def violated_disks(self, gctx, placement, agg):
+        limit = gctx.capacity_threshold[Resource.DISK] * gctx.state.disk_capacity
+        return (agg.disk_load > limit) & gctx.state.disk_alive
+
+    def violated_brokers(self, gctx, placement, agg):
+        return jnp.any(self.violated_disks(gctx, placement, agg), axis=-1)
+
+    def disk_candidate_score(self, gctx, placement, agg):
+        """f32[R]: replicas on over-limit or dead disks, largest first."""
+        state = gctx.state
+        vd = self.violated_disks(gctx, placement, agg)
+        on_bad = vd[placement.broker, placement.disk]
+        dead_disk = ~state.disk_alive[placement.broker, placement.disk]
+        size = state.leader_load[:, Resource.DISK]
+        cand = (on_bad | dead_disk) & state.valid
+        return jnp.where(cand, size, NEG_INF)
+
+    def disk_move_ok(self, gctx, placement, agg, r, d):
+        """bool: replica r may move to disk d of its own broker."""
+        b = placement.broker[r]
+        size = gctx.state.leader_load[r, Resource.DISK]
+        limit = gctx.capacity_threshold[Resource.DISK] * gctx.state.disk_capacity[b, d]
+        return (gctx.state.disk_alive[b, d] & (d != placement.disk[r])
+                & (agg.disk_load[b, d] + size <= limit))
+
+    def stats_metric(self, gctx, placement, agg):
+        limit = gctx.capacity_threshold[Resource.DISK] * gctx.state.disk_capacity
+        excess = jnp.maximum(agg.disk_load - limit, 0.0) * gctx.state.disk_alive
+        return jnp.sum(excess)

@@ -1,0 +1,237 @@
+"""Replica-count distribution (soft) goals.
+
+Reference: ``analyzer/goals/ReplicaDistributionAbstractGoal.java`` and
+subclasses ``ReplicaDistributionGoal.java``,
+``LeaderReplicaDistributionGoal.java``, ``TopicReplicaDistributionGoal.java``.
+
+Count bands mirror the load bands: with avg = alive replicas / alive brokers,
+a broker should hold between ``floor(avg*(2-T))`` and ``ceil(avg*T)`` replicas
+(leader replicas / per-topic replicas for the sibling goals).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    current_leader_of,
+    currently_offline,
+)
+from cruise_control_tpu.analyzer.goals.base import Goal, NEG_INF, OFFLINE_BONUS, alive_mask
+from cruise_control_tpu.model.state import Placement
+
+
+def _count_bounds(counts, alive, threshold):
+    """(upper i32, lower i32) band around the alive-broker average count."""
+    n = jnp.maximum(jnp.sum(alive), 1)
+    avg = jnp.sum(jnp.where(alive, counts, 0)) / n
+    upper = jnp.ceil(avg * threshold).astype(jnp.int32)
+    lower = jnp.floor(avg * (2.0 - threshold)).astype(jnp.int32)
+    return jnp.maximum(upper, 1), jnp.maximum(lower, 0)
+
+
+class ReplicaDistributionGoal(Goal):
+    """Even replica counts across brokers (ReplicaDistributionGoal.java)."""
+
+    name = "ReplicaDistributionGoal"
+    is_hard = False
+    has_pull_phase = True
+
+    def _counts(self, gctx, agg):
+        return agg.replica_counts
+
+    def _threshold(self, gctx):
+        return gctx.replica_balance_threshold
+
+    def _bounds(self, gctx, agg):
+        return _count_bounds(self._counts(gctx, agg), alive_mask(gctx),
+                             self._threshold(gctx))
+
+    def violated_brokers(self, gctx, placement, agg):
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        alive = alive_mask(gctx)
+        dead_with = (~gctx.state.alive) & gctx.state.broker_valid & (c > 0)
+        return ((c > upper) | (c < lower)) & alive | dead_with
+
+    def _over_brokers(self, gctx, agg):
+        upper, _ = self._bounds(gctx, agg)
+        return (self._counts(gctx, agg) > upper) & alive_mask(gctx)
+
+    def candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        over = self._over_brokers(gctx, agg)
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = over[placement.broker] & state.valid & ~gctx.replica_excluded
+        score = jnp.where(cand, prio, NEG_INF)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, prio + OFFLINE_BONUS, score)
+
+    def replica_priority(self, gctx, placement, agg):
+        # Lightest replicas first: count goals shouldn't disturb load balance.
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return -jnp.sum(load / jnp.maximum(
+            jnp.mean(gctx.state.capacity, axis=0, keepdims=True), 1e-9), axis=-1)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        src = placement.broker[jnp.asarray(r)]
+        dst_ok = c[dst] + 1 <= upper
+        src_ok = (c[src] - 1 >= lower) | ~gctx.state.alive[src]
+        offline = currently_offline(gctx, placement, r)
+        return dst_ok & (src_ok | offline)
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        del r
+        return self._counts(gctx, agg)[dst].astype(jnp.float32)
+
+    def pull_dst_mask(self, gctx, placement, agg):
+        _, lower = self._bounds(gctx, agg)
+        return (self._counts(gctx, agg) < lower) & alive_mask(gctx)
+
+    def pull_candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        c = self._counts(gctx, agg)
+        alive = alive_mask(gctx)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        avg = jnp.sum(jnp.where(alive, c, 0)) / n
+        hot = c > avg
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = (hot[placement.broker] & state.valid & ~currently_offline(gctx, placement)
+                & ~gctx.replica_excluded)
+        return jnp.where(cand, prio, NEG_INF)
+
+    def stats_metric(self, gctx, placement, agg):
+        alive = alive_mask(gctx)
+        c = self._counts(gctx, agg).astype(jnp.float32)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        mean = jnp.sum(jnp.where(alive, c, 0.0)) / n
+        var = jnp.sum(jnp.where(alive, (c - mean) ** 2, 0.0)) / n
+        return jnp.sqrt(var)
+
+
+class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
+    """Even *leader* counts (LeaderReplicaDistributionGoal.java): leadership
+    transfers first, leader-replica moves as fallback."""
+
+    name = "LeaderReplicaDistributionGoal"
+    uses_leadership_moves = True
+    has_pull_phase = False
+
+    def _counts(self, gctx, agg):
+        return agg.leader_counts
+
+    def _threshold(self, gctx):
+        return gctx.leader_replica_balance_threshold
+
+    def candidate_score(self, gctx, placement, agg):
+        # Only leader replicas on over-count brokers are move candidates.
+        base = super().candidate_score(gctx, placement, agg)
+        return jnp.where(placement.is_leader, base, NEG_INF)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """Follower moves don't change leader counts; leader moves do."""
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        r = jnp.asarray(r)
+        is_lead = placement.is_leader[r]
+        src = placement.broker[r]
+        dst_ok = c[dst] + 1 <= upper
+        src_ok = ((c[src] - 1 >= lower) | ~gctx.state.alive[src]
+                  | currently_offline(gctx, placement, r))
+        return ~is_lead | (dst_ok & src_ok)
+
+    def leadership_candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        over = self._over_brokers(gctx, agg)
+        f = jnp.arange(state.num_replicas_padded)
+        lead = current_leader_of(gctx, placement, state.partition[f])
+        lb = placement.broker[jnp.maximum(lead, 0)]
+        c = self._counts(gctx, agg)
+        cand = ((lead >= 0) & over[lb] & ~placement.is_leader & state.valid
+                & ~currently_offline(gctx, placement) & ~gctx.replica_excluded)
+        # Prefer promoting onto the emptiest brokers.
+        return jnp.where(cand, -c[placement.broker].astype(jnp.float32), NEG_INF)
+
+    def leadership_self_ok(self, gctx, placement, agg, f):
+        upper, _ = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        return c[placement.broker[jnp.asarray(f)]] + 1 <= upper
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        """Promotion adds one leader to f's broker — veto when that would
+        reach or deepen an upper-bound violation."""
+        upper, _ = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        b = placement.broker[jnp.asarray(f)]
+        return c[b] + 1 <= upper
+
+    def stats_metric(self, gctx, placement, agg):
+        return super().stats_metric(gctx, placement, agg)
+
+
+class TopicReplicaDistributionGoal(Goal):
+    """Even per-topic replica counts (TopicReplicaDistributionGoal.java)."""
+
+    name = "TopicReplicaDistributionGoal"
+    is_hard = False
+
+    def _bounds(self, gctx, agg):
+        """(upper i32[T], lower i32[T]) per-topic count bands."""
+        alive = alive_mask(gctx)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        totals = jnp.sum(jnp.where(alive[None, :], agg.topic_counts, 0), axis=1)  # [T]
+        avg = totals / n
+        t = gctx.topic_replica_balance_threshold
+        gap = gctx.topic_replica_balance_min_gap
+        upper = jnp.maximum(jnp.ceil(avg * t), jnp.ceil(avg) + gap).astype(jnp.int32)
+        lower = jnp.maximum(jnp.floor(avg * (2.0 - t)), 0.0).astype(jnp.int32)
+        return upper, lower
+
+    def violated_brokers(self, gctx, placement, agg):
+        upper, lower = self._bounds(gctx, agg)
+        over = agg.topic_counts > upper[:, None]
+        under = agg.topic_counts < lower[:, None]
+        return jnp.any(over | under, axis=0) & alive_mask(gctx)
+
+    def candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        upper, _ = self._bounds(gctx, agg)
+        c_rt = agg.topic_counts[state.topic, placement.broker]     # [R]
+        over = (c_rt > upper[state.topic]) & alive_mask(gctx)[placement.broker]
+        prio = c_rt.astype(jnp.float32)
+        cand = over & state.valid & ~gctx.replica_excluded
+        score = jnp.where(cand, prio, NEG_INF)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, prio + OFFLINE_BONUS, score)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        upper, lower = self._bounds(gctx, agg)
+        r = jnp.asarray(r)
+        t = gctx.state.topic[r]
+        src = placement.broker[r]
+        dst_ok = agg.topic_counts[t, dst] + 1 <= upper[t]
+        src_ok = ((agg.topic_counts[t, src] - 1 >= lower[t])
+                  | ~gctx.state.alive[src] | currently_offline(gctx, placement, r))
+        return dst_ok & src_ok
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        t = gctx.state.topic[jnp.asarray(r)]
+        return agg.topic_counts[t, dst].astype(jnp.float32)
+
+    def stats_metric(self, gctx, placement, agg):
+        upper, lower = self._bounds(gctx, agg)
+        over = jnp.maximum(agg.topic_counts - upper[:, None], 0)
+        under = jnp.maximum(lower[:, None] - agg.topic_counts, 0)
+        alive = alive_mask(gctx)
+        return jnp.sum(jnp.where(alive[None, :], over + under, 0)).astype(jnp.float32)

@@ -1,0 +1,77 @@
+"""Intra-broker disk balance (soft).
+
+Reference: ``analyzer/goals/IntraBrokerDiskUsageDistributionGoal.java`` —
+keep each JBOD broker's logdirs within a band around the broker's own mean
+disk utilization, via intra-broker replica moves (``alterReplicaLogDirs`` at
+execution time).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import Aggregates, GoalContext
+from cruise_control_tpu.analyzer.goals.base import Goal, NEG_INF
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import Placement
+
+
+class IntraBrokerDiskUsageDistributionGoal(Goal):
+    name = "IntraBrokerDiskUsageDistributionGoal"
+    is_hard = False
+    uses_replica_moves = False
+    intra_disk = True
+
+    def _bands(self, gctx, agg):
+        """(upper f32[B,D], lower f32[B,D]) absolute per-disk load bounds."""
+        cap = gctx.state.disk_capacity
+        alive = gctx.state.disk_alive
+        total = jnp.sum(jnp.where(alive, agg.disk_load, 0.0), axis=1, keepdims=True)
+        tcap = jnp.sum(jnp.where(alive, cap, 0.0), axis=1, keepdims=True)
+        avg_frac = total / jnp.maximum(tcap, 1e-9)            # [B,1]
+        t = gctx.balance_threshold[Resource.DISK]
+        upper = avg_frac * t * cap
+        lower = avg_frac * (2.0 - t) * cap
+        return upper, lower
+
+    def violated_disks(self, gctx, placement, agg):
+        upper, lower = self._bands(gctx, agg)
+        alive = gctx.state.disk_alive
+        multi = jnp.sum(alive.astype(jnp.int32), axis=1, keepdims=True) > 1
+        out = (agg.disk_load > upper) | (agg.disk_load < lower)
+        return out & alive & multi
+
+    def violated_brokers(self, gctx, placement, agg):
+        return jnp.any(self.violated_disks(gctx, placement, agg), axis=-1)
+
+    def disk_candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        upper, _ = self._bands(gctx, agg)
+        over = (agg.disk_load > upper) & state.disk_alive
+        on_over = over[placement.broker, placement.disk]
+        dead = ~state.disk_alive[placement.broker, placement.disk]
+        size = state.leader_load[:, Resource.DISK]
+        cand = (on_over | dead) & state.valid
+        return jnp.where(cand, size, NEG_INF)
+
+    def disk_move_ok(self, gctx, placement, agg, r, d):
+        upper, lower = self._bands(gctx, agg)
+        b = placement.broker[jnp.asarray(r)]
+        size = gctx.state.leader_load[jnp.asarray(r), Resource.DISK]
+        src_d = placement.disk[jnp.asarray(r)]
+        dst_after = agg.disk_load[b, d] + size
+        src_after = agg.disk_load[b, src_d] - size
+        ok = ((dst_after <= upper[b, d]) & (src_after >= lower[b, src_d])
+              & gctx.state.disk_alive[b, d] & (d != src_d))
+        dead_src = ~gctx.state.disk_alive[b, src_d]
+        return jnp.where(dead_src, gctx.state.disk_alive[b, d] & (d != src_d), ok)
+
+    def stats_metric(self, gctx, placement, agg):
+        """Mean per-broker stdev of disk utilization fractions."""
+        cap = jnp.maximum(gctx.state.disk_capacity, 1e-9)
+        frac = agg.disk_load / cap
+        alive = gctx.state.disk_alive
+        n = jnp.maximum(jnp.sum(alive, axis=1), 1)
+        mean = jnp.sum(jnp.where(alive, frac, 0.0), axis=1) / n
+        var = jnp.sum(jnp.where(alive, (frac - mean[:, None]) ** 2, 0.0), axis=1) / n
+        return jnp.mean(jnp.sqrt(var))

@@ -1,0 +1,326 @@
+"""Load-distribution (soft) goals.
+
+Reference: ``analyzer/goals/ResourceDistributionGoal.java:54-1016`` and its
+four resource subclasses, ``PotentialNwOutGoal.java``,
+``LeaderBytesInDistributionGoal.java``.
+
+ResourceDistribution semantics (initGoalState :236-263): every alive broker's
+utilization for the resource must sit inside ``[avg*(2-T), avg*T]`` where avg
+is the cluster-wide alive utilization fraction scaled by broker capacity.
+Mechanisms (rebalanceForBroker :349-405): move replicas out of hot brokers,
+pull replicas into cold ones, and move leadership for CPU/NW_OUT.  Here each
+mechanism is a phase of the shared solver; the acceptance veto (``accept_*``)
+is the same band predicate applied to later goals' candidate actions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    current_leader_of,
+    currently_offline,
+    replica_role_load,
+)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal,
+    NEG_INF,
+    OFFLINE_BONUS,
+    alive_mask,
+    avg_alive_util_fraction,
+)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import Placement
+
+
+class ResourceDistributionGoal(Goal):
+    """Keep one resource's per-broker utilization inside the balance band."""
+
+    is_hard = False
+    has_pull_phase = True
+    resource: int = Resource.DISK
+
+    def __init__(self, resource: int, name: str):
+        self.resource = int(resource)
+        self.name = name
+        # Leadership shifts load only for CPU/NW_OUT (follower NW_IN ≈ leader NW_IN).
+        self.uses_leadership_moves = resource in (Resource.CPU, Resource.NW_OUT)
+
+    # ----------------------------------------------------------- band maths
+
+    def _bounds(self, gctx: GoalContext, agg: Aggregates):
+        """(upper f32[B], lower f32[B], lower_active bool): absolute load bounds."""
+        res = self.resource
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        t = gctx.balance_threshold[res]
+        cap = gctx.state.capacity[:, res]
+        upper = avg * t * cap
+        lower = avg * (2.0 - t) * cap
+        # Low-utilization guard: when the cluster barely uses this resource,
+        # only the upper bound matters (reference: low.utilization.threshold).
+        lower_active = avg >= gctx.low_utilization_threshold[res]
+        return upper, lower, lower_active
+
+    def violated_brokers(self, gctx, placement, agg):
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        load = agg.broker_load[:, res]
+        over = load > upper
+        under = (load < lower) & lower_active
+        return (over | under) & alive_mask(gctx)
+
+    def _over_brokers(self, gctx, agg):
+        upper, _, _ = self._bounds(gctx, agg)
+        return (agg.broker_load[:, self.resource] > upper) & alive_mask(gctx)
+
+    # ------------------------------------------------------- move-out phase
+
+    def candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        over = self._over_brokers(gctx, agg)
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = over[placement.broker] & state.valid & ~gctx.replica_excluded
+        score = jnp.where(cand, prio, NEG_INF)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, prio + OFFLINE_BONUS, score)
+
+    def replica_priority(self, gctx, placement, agg):
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return load[:, self.resource]
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        """Move keeps dst inside the band and strictly reduces deviation."""
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        load = replica_role_load(gctx, placement, r)[..., res]
+        src = placement.broker[jnp.asarray(r)]
+        src_after = agg.broker_load[src, res] - load
+        dst_after = agg.broker_load[dst, res] + load
+        dst_ok = dst_after <= upper[dst]
+        # Don't overshoot the source below its lower bound...
+        src_ok = jnp.where(lower_active, src_after >= lower[src], True)
+        # ...unless the replica is bigger than the band itself.
+        ok = dst_ok & src_ok
+        offline = currently_offline(gctx, placement, r)
+        return jnp.where(offline, dst_ok, ok)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """actionAcceptance (:803-871): later goals may not push dst over the
+        upper bound nor drain src below the lower bound."""
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        load = replica_role_load(gctx, placement, r)[..., res]
+        src = placement.broker[jnp.asarray(r)]
+        src_after = agg.broker_load[src, res] - load
+        dst_after = agg.broker_load[dst, res] + load
+        dst_before = agg.broker_load[dst, res]
+        # If dst was already over (shouldn't happen post-optimization), only
+        # reject when the move makes it worse.
+        dst_ok = (dst_after <= upper[dst]) | ((dst_before > upper[dst]) & (load <= 0))
+        src_ok = jnp.where(lower_active, (src_after >= lower[src]) | (load <= 0), True)
+        return dst_ok & src_ok
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        res = self.resource
+        load = replica_role_load(gctx, placement, r)[..., res]
+        after = agg.broker_load[dst, res] + load
+        return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
+
+    # ------------------------------------------------------ leadership phase
+
+    def _leader_broker_of(self, gctx, placement, f):
+        lead = current_leader_of(gctx, placement, gctx.state.partition[jnp.asarray(f)])
+        return placement.broker[jnp.maximum(lead, 0)], lead >= 0
+
+    def leadership_candidate_score(self, gctx, placement, agg):
+        """Followers whose leader sits on an over-band broker."""
+        res = self.resource
+        state = gctx.state
+        over = self._over_brokers(gctx, agg)
+        f = jnp.arange(state.num_replicas_padded)
+        lb, has = self._leader_broker_of(gctx, placement, f)
+        gain = state.leader_load[:, res] - state.follower_load[:, res]
+        cand = (has & over[lb] & ~placement.is_leader & state.valid
+                & ~currently_offline(gctx, placement) & ~gctx.replica_excluded & (gain > 0))
+        return jnp.where(cand, gain, NEG_INF)
+
+    def leadership_self_ok(self, gctx, placement, agg, f):
+        res = self.resource
+        upper, _, _ = self._bounds(gctx, agg)
+        f = jnp.asarray(f)
+        delta = gctx.state.leader_load[f, res] - gctx.state.follower_load[f, res]
+        b = placement.broker[f]
+        return agg.broker_load[b, res] + delta <= upper[b]
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        res = self.resource
+        if not self.uses_leadership_moves and res != Resource.NW_IN:
+            # DISK unaffected by leadership.
+            return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        f = jnp.asarray(f)
+        delta = gctx.state.leader_load[f, res] - gctx.state.follower_load[f, res]
+        b = placement.broker[f]
+        after = agg.broker_load[b, res] + delta
+        return (after <= upper[b]) | (delta <= 0)
+
+    # ------------------------------------------------------------ pull phase
+
+    def pull_dst_mask(self, gctx, placement, agg):
+        res = self.resource
+        _, lower, lower_active = self._bounds(gctx, agg)
+        under = (agg.broker_load[:, res] < lower) & alive_mask(gctx)
+        return under & lower_active
+
+    def pull_candidate_score(self, gctx, placement, agg):
+        """Pull from brokers above cluster-average utilization."""
+        res = self.resource
+        state = gctx.state
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        src_hot = agg.broker_load[:, res] > avg * state.capacity[:, res]
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = (src_hot[placement.broker] & state.valid & ~currently_offline(gctx, placement)
+                & ~gctx.replica_excluded)
+        return jnp.where(cand, prio, NEG_INF)
+
+    # -------------------------------------------------------------- metrics
+
+    def stats_metric(self, gctx, placement, agg):
+        """Utilization-fraction stdev over alive brokers (the comparator at
+        ResourceDistributionGoal.java:977-1008 compares stdev)."""
+        res = self.resource
+        alive = alive_mask(gctx)
+        frac = agg.broker_load[:, res] / jnp.maximum(gctx.state.capacity[:, res], 1e-9)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        mean = jnp.sum(jnp.where(alive, frac, 0.0)) / n
+        var = jnp.sum(jnp.where(alive, (frac - mean) ** 2, 0.0)) / n
+        return jnp.sqrt(var)
+
+
+class CpuUsageDistributionGoal(ResourceDistributionGoal):
+    def __init__(self):
+        super().__init__(Resource.CPU, "CpuUsageDistributionGoal")
+
+
+class NetworkInboundUsageDistributionGoal(ResourceDistributionGoal):
+    def __init__(self):
+        super().__init__(Resource.NW_IN, "NetworkInboundUsageDistributionGoal")
+
+
+class NetworkOutboundUsageDistributionGoal(ResourceDistributionGoal):
+    def __init__(self):
+        super().__init__(Resource.NW_OUT, "NetworkOutboundUsageDistributionGoal")
+
+
+class DiskUsageDistributionGoal(ResourceDistributionGoal):
+    """Broker-level disk balance (reference DiskUsageDistributionGoal.java —
+    the non-kafka-assigner subclass balances % disk usage across brokers)."""
+
+    def __init__(self):
+        super().__init__(Resource.DISK, "DiskUsageDistributionGoal")
+
+
+class PotentialNwOutGoal(Goal):
+    """Cap *potential* network-out — NW_OUT if the broker led everything it
+    hosts — under the hard NW_OUT capacity (PotentialNwOutGoal.java)."""
+
+    name = "PotentialNwOutGoal"
+    is_hard = False
+
+    def _limit(self, gctx, b):
+        return (gctx.capacity_threshold[Resource.NW_OUT]
+                * gctx.state.capacity[b, Resource.NW_OUT])
+
+    def violated_brokers(self, gctx, placement, agg):
+        b = jnp.arange(gctx.state.num_brokers_padded)
+        return (agg.potential_nw_out > self._limit(gctx, b)) & alive_mask(gctx)
+
+    def replica_priority(self, gctx, placement, agg):
+        return gctx.state.leader_load[:, Resource.NW_OUT]
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        pot = gctx.state.leader_load[jnp.asarray(r), Resource.NW_OUT]
+        after = agg.potential_nw_out[dst] + pot
+        # Accept if dst stays under its potential limit, or the cluster is
+        # already hopeless there and the move doesn't originate from this goal
+        # (mirrors PotentialNwOutGoal acceptance: reject only when dst becomes
+        # newly violated).
+        was_over = agg.potential_nw_out[dst] > self._limit(gctx, dst)
+        return (after <= self._limit(gctx, dst)) | was_over & (pot <= 0)
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        pot = gctx.state.leader_load[jnp.asarray(r), Resource.NW_OUT]
+        return (agg.potential_nw_out[dst] + pot) / jnp.maximum(
+            gctx.state.capacity[dst, Resource.NW_OUT], 1e-9)
+
+    def stats_metric(self, gctx, placement, agg):
+        b = jnp.arange(gctx.state.num_brokers_padded)
+        excess = jnp.maximum(agg.potential_nw_out - self._limit(gctx, b), 0.0)
+        return jnp.sum(jnp.where(alive_mask(gctx), excess, 0.0))
+
+
+class LeaderBytesInDistributionGoal(Goal):
+    """Even out leader bytes-in across brokers
+    (LeaderBytesInDistributionGoal.java — balances only above the mean)."""
+
+    name = "LeaderBytesInDistributionGoal"
+    is_hard = False
+    uses_replica_moves = False
+    uses_leadership_moves = True
+
+    def _limit(self, gctx, agg):
+        alive = alive_mask(gctx)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        avg = jnp.sum(jnp.where(alive, agg.leader_bytes_in, 0.0)) / n
+        return avg * gctx.balance_threshold[Resource.NW_IN]
+
+    def violated_brokers(self, gctx, placement, agg):
+        return (agg.leader_bytes_in > self._limit(gctx, agg)) & alive_mask(gctx)
+
+    def leadership_candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        over = self.violated_brokers(gctx, placement, agg)
+        f = jnp.arange(state.num_replicas_padded)
+        lead = current_leader_of(gctx, placement, state.partition[f])
+        lb = placement.broker[jnp.maximum(lead, 0)]
+        nw_in = state.leader_load[:, Resource.NW_IN]
+        cand = ((lead >= 0) & over[lb] & ~placement.is_leader & state.valid
+                & ~currently_offline(gctx, placement) & ~gctx.replica_excluded)
+        return jnp.where(cand, nw_in, NEG_INF)
+
+    def leadership_self_ok(self, gctx, placement, agg, f):
+        f = jnp.asarray(f)
+        limit = self._limit(gctx, agg)
+        b = placement.broker[f]
+        after = agg.leader_bytes_in[b] + gctx.state.leader_load[f, Resource.NW_IN]
+        return after <= limit
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        f = jnp.asarray(f)
+        limit = self._limit(gctx, agg)
+        b = placement.broker[f]
+        nw_in = gctx.state.leader_load[f, Resource.NW_IN]
+        after = agg.leader_bytes_in[b] + nw_in
+        was_over = agg.leader_bytes_in[b] > limit
+        return (after <= limit) | was_over & (nw_in <= 0)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """Leader replica moves carry their bytes-in to dst."""
+        r = jnp.asarray(r)
+        nw_in = jnp.where(placement.is_leader[r],
+                          gctx.state.leader_load[r, Resource.NW_IN], 0.0)
+        limit = self._limit(gctx, agg)
+        after = agg.leader_bytes_in[dst] + nw_in
+        was_over = agg.leader_bytes_in[dst] > limit
+        return (after <= limit) | was_over & (nw_in <= 0)
+
+    def stats_metric(self, gctx, placement, agg):
+        alive = alive_mask(gctx)
+        excess = jnp.maximum(agg.leader_bytes_in - self._limit(gctx, agg), 0.0)
+        return jnp.sum(jnp.where(alive, excess, 0.0))

@@ -1,0 +1,28 @@
+"""kafka-assigner emulation goals.
+
+Reference: ``analyzer/kafkaassigner/KafkaAssignerEvenRackAwareGoal.java`` and
+``KafkaAssignerDiskUsageDistributionGoal.java`` — legacy goal pair selected
+when a request carries ``kafka_assigner=true`` (RunnableUtils.isKafkaAssignerMode).
+
+The even-rack goal's contract (replicas of a partition land on distinct racks,
+spread evenly by replica position) is the strict-rack invariant plus even
+spread — realised here as the relaxed-rack kernels with the strict cap; the
+disk goal is broker-level disk balance with the kafka-assigner's swap-style
+threshold semantics, which the shared solver covers via moves.
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.analyzer.goals.distribution import ResourceDistributionGoal
+from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
+from cruise_control_tpu.common.resources import Resource
+
+
+class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
+    name = "KafkaAssignerEvenRackAwareGoal"
+    is_hard = True
+
+
+class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
+    def __init__(self):
+        super().__init__(Resource.DISK, "KafkaAssignerDiskUsageDistributionGoal")

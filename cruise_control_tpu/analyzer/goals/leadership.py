@@ -1,0 +1,136 @@
+"""Leadership-centric goals.
+
+Reference: ``analyzer/goals/PreferredLeaderElectionGoal.java:35-208`` (move
+leadership to the first eligible replica in each partition's replica list —
+used by broker demotion) and ``MinTopicLeadersPerBrokerGoal.java`` (each
+alive broker must lead at least N partitions of configured topics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    current_leader_of,
+    currently_offline,
+)
+from cruise_control_tpu.analyzer.goals.base import Goal, NEG_INF, alive_mask
+from cruise_control_tpu.model.state import Placement
+
+_BIG = jnp.int32(1 << 30)
+
+
+class PreferredLeaderElectionGoal(Goal):
+    """Direct transform, not a search: for every partition, leadership goes to
+    the lowest-position eligible replica (alive broker, not offline, broker
+    not excluded from leadership)."""
+
+    name = "PreferredLeaderElectionGoal"
+    is_hard = False
+    is_direct = True
+    uses_replica_moves = False
+
+    def direct_apply(self, gctx: GoalContext, placement: Placement,
+                     agg: Aggregates) -> Placement:
+        state = gctx.state
+        sibs = gctx.partition_replicas                       # [P, RF]
+        safe = jnp.maximum(sibs, 0)
+        sib_b = placement.broker[safe]
+        off = currently_offline(gctx, placement)
+        eligible = ((sibs >= 0) & state.valid[safe] & ~off[safe]
+                    & state.alive[sib_b] & ~gctx.excluded_for_leadership[sib_b]
+                    & ~gctx.replica_excluded[safe])
+        key = jnp.where(eligible, state.pos[safe], _BIG)     # [P, RF]
+        choice_slot = jnp.argmin(key, axis=-1)               # [P]
+        any_ok = jnp.any(eligible, axis=-1)
+        chosen = jnp.take_along_axis(safe, choice_slot[:, None], axis=1)[:, 0]
+
+        # Keep the current leader where no replica is eligible.
+        cur_leader = _current_leaders(gctx, placement)        # i32[P]
+        final = jnp.where(any_ok, chosen, jnp.maximum(cur_leader, 0))
+        has_any = any_ok | (cur_leader >= 0)
+        # Padded partitions (all sibs -1) map to replica 0 — mask them out.
+        real_p = jnp.any(sibs >= 0, axis=-1)
+        is_leader = jnp.zeros_like(placement.is_leader).at[final].max(has_any & real_p)
+        return placement.replace(is_leader=is_leader)
+
+    def violated_brokers(self, gctx, placement, agg):
+        return jnp.zeros(gctx.state.num_brokers_padded, dtype=bool)
+
+
+def _current_leaders(gctx: GoalContext, placement: Placement) -> jnp.ndarray:
+    """i32[P]: current leader replica row per partition (-1 if none)."""
+    sibs = gctx.partition_replicas
+    safe = jnp.maximum(sibs, 0)
+    is_l = (sibs >= 0) & placement.is_leader[safe]
+    slot = jnp.argmax(is_l, axis=-1)
+    got = jnp.take_along_axis(safe, slot[:, None], axis=1)[:, 0]
+    return jnp.where(jnp.any(is_l, axis=-1), got, -1)
+
+
+class MinTopicLeadersPerBrokerGoal(Goal):
+    """Each alive broker leads ≥ N partitions of each configured topic
+    (MinTopicLeadersPerBrokerGoal.java).  No configured topics → no-op."""
+
+    name = "MinTopicLeadersPerBrokerGoal"
+    is_hard = True
+    uses_replica_moves = False
+    uses_leadership_moves = True
+
+    def _deficit(self, gctx, agg):
+        """i32[T, B]: missing leaders per (relevant topic, alive broker)."""
+        need = jnp.where(gctx.min_leader_topic_mask[:, None], gctx.min_topic_leaders, 0)
+        deficit = jnp.maximum(need - agg.topic_leader_counts, 0)
+        return jnp.where(alive_mask(gctx)[None, :], deficit, 0)
+
+    def violated_brokers(self, gctx, placement, agg):
+        return jnp.any(self._deficit(gctx, agg) > 0, axis=0)
+
+    def leadership_candidate_score(self, gctx, placement, agg):
+        """Promote followers of relevant topics sitting on deficit brokers,
+        when the current leader's broker has surplus."""
+        state = gctx.state
+        deficit = self._deficit(gctx, agg)
+        f = jnp.arange(state.num_replicas_padded)
+        t = state.topic
+        b = placement.broker
+        my_deficit = deficit[t, b] > 0
+        lead = current_leader_of(gctx, placement, state.partition[f])
+        lb = placement.broker[jnp.maximum(lead, 0)]
+        donor_ok = (lead >= 0) & (
+            (agg.topic_leader_counts[t, lb] - 1 >= gctx.min_topic_leaders)
+            | ~gctx.min_leader_topic_mask[t])
+        cand = (my_deficit & donor_ok & ~placement.is_leader & state.valid
+                & ~currently_offline(gctx, placement) & ~gctx.replica_excluded
+                & gctx.min_leader_topic_mask[t])
+        return jnp.where(cand, deficit[t, b].astype(jnp.float32), NEG_INF)
+
+    def leadership_self_ok(self, gctx, placement, agg, f):
+        f = jnp.asarray(f)
+        t = gctx.state.topic[f]
+        b = placement.broker[f]
+        return self._deficit(gctx, agg)[t, b] > 0
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        """Later goals may not demote a leader off a broker already at minimum."""
+        f = jnp.asarray(f)
+        t = gctx.state.topic[f]
+        lead = current_leader_of(gctx, placement, gctx.state.partition[f])
+        lb = placement.broker[jnp.maximum(lead, 0)]
+        relevant = gctx.min_leader_topic_mask[t] & (lead >= 0)
+        donor_ok = agg.topic_leader_counts[t, lb] - 1 >= gctx.min_topic_leaders
+        return ~relevant | donor_ok
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """Moving a relevant-topic leader off a broker at minimum is vetoed."""
+        r = jnp.asarray(r)
+        t = gctx.state.topic[r]
+        src = placement.broker[r]
+        relevant = gctx.min_leader_topic_mask[t] & placement.is_leader[r]
+        src_ok = (agg.topic_leader_counts[t, src] - 1 >= gctx.min_topic_leaders)
+        return ~relevant | src_ok | ~gctx.state.alive[src]
+
+    def stats_metric(self, gctx, placement, agg):
+        return jnp.sum(self._deficit(gctx, agg)).astype(jnp.float32)

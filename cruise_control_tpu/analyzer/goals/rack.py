@@ -1,0 +1,133 @@
+"""Rack-awareness goals.
+
+Reference: ``analyzer/goals/RackAwareGoal.java:31-221`` (strict: no two
+replicas of a partition on one rack), ``RackAwareDistributionGoal.java``
+(relaxed: replicas spread as evenly as possible, >1 per rack allowed when
+replicas > racks), base ``AbstractRackAwareGoal.java``.
+
+All checks reduce to RF-wide gathers over ``partition_replicas``: a replica's
+sibling racks are ``rack[broker[sibs]]`` — never a P×B or P×K materialization
+inside the move loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import Aggregates, GoalContext, currently_offline
+from cruise_control_tpu.analyzer.goals.base import Goal, alive_mask
+from cruise_control_tpu.model.state import Placement
+
+
+def _sibling_info(gctx: GoalContext, placement: Placement, r):
+    """(is_sib bool[...,RF], sib_rack i32[...,RF]) for replica r's partition."""
+    r = jnp.asarray(r)
+    sibs = gctx.partition_replicas[gctx.state.partition[r]]
+    is_sib = (sibs >= 0) & (sibs != r[..., None])
+    sib_rack = gctx.state.rack[placement.broker[jnp.maximum(sibs, 0)]]
+    return is_sib, sib_rack
+
+
+def replicas_violating_rack(gctx: GoalContext, placement: Placement) -> jnp.ndarray:
+    """bool[R]: replica shares its rack with a sibling (strict violation)."""
+    r = jnp.arange(gctx.state.num_replicas_padded)
+    is_sib, sib_rack = _sibling_info(gctx, placement, r)
+    own = gctx.state.rack[placement.broker][:, None]
+    return jnp.any(is_sib & (sib_rack == own), axis=-1) & gctx.state.valid
+
+
+def num_alive_racks(gctx: GoalContext) -> jnp.ndarray:
+    alive = alive_mask(gctx)
+    present = jnp.zeros(gctx.num_racks, dtype=jnp.int32).at[gctx.state.rack].max(
+        alive.astype(jnp.int32))
+    return jnp.maximum(jnp.sum(present), 1)
+
+
+class RackAwareGoal(Goal):
+    """Strict rack-awareness (hard)."""
+
+    name = "RackAwareGoal"
+    is_hard = True
+
+    def violated_brokers(self, gctx, placement, agg):
+        viol = replicas_violating_rack(gctx, placement)
+        b = gctx.state.num_brokers_padded
+        per_broker = jnp.zeros(b, dtype=bool).at[placement.broker].max(viol)
+        return per_broker
+
+    def candidate_score(self, gctx, placement, agg):
+        # Only the violating replicas themselves move (not whole brokers).
+        viol = replicas_violating_rack(gctx, placement)
+        prio = self.replica_priority(gctx, placement, agg)
+        score = jnp.where(viol & ~gctx.replica_excluded, prio, -jnp.inf)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, prio + 1e30, score)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """Destination rack must hold no sibling replica."""
+        is_sib, sib_rack = _sibling_info(gctx, placement, r)
+        dst_rack = gctx.state.rack[jnp.asarray(dst)]
+        return ~jnp.any(is_sib & (sib_rack == dst_rack[..., None]), axis=-1)
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+
+    def stats_metric(self, gctx, placement, agg):
+        return jnp.sum(replicas_violating_rack(gctx, placement).astype(jnp.float32))
+
+
+class RackAwareDistributionGoal(Goal):
+    """Relaxed rack-awareness (hard): per-partition rack counts must not
+    differ by more than what pigeonholing forces, i.e. every rack holds at
+    most ceil(RF / alive_racks) replicas of a partition."""
+
+    name = "RackAwareDistributionGoal"
+    is_hard = True
+
+    def _rack_cap(self, gctx, r):
+        """i32[...]: max allowed replicas of r's partition per rack."""
+        sibs = gctx.partition_replicas[gctx.state.partition[jnp.asarray(r)]]
+        rf = jnp.sum((sibs >= 0).astype(jnp.int32), axis=-1)
+        k = num_alive_racks(gctx)
+        return -(-rf // k)  # ceil division
+
+    def _own_rack_count(self, gctx, placement, r):
+        """i32[...]: replicas of r's partition currently on r's rack (incl. r)."""
+        is_sib, sib_rack = _sibling_info(gctx, placement, r)
+        own = gctx.state.rack[placement.broker[jnp.asarray(r)]]
+        return 1 + jnp.sum((is_sib & (sib_rack == own[..., None])).astype(jnp.int32),
+                           axis=-1)
+
+    def violated_replicas(self, gctx, placement):
+        r = jnp.arange(gctx.state.num_replicas_padded)
+        over = self._own_rack_count(gctx, placement, r) > self._rack_cap(gctx, r)
+        return over & gctx.state.valid
+
+    def violated_brokers(self, gctx, placement, agg):
+        viol = self.violated_replicas(gctx, placement)
+        b = gctx.state.num_brokers_padded
+        return jnp.zeros(b, dtype=bool).at[placement.broker].max(viol)
+
+    def candidate_score(self, gctx, placement, agg):
+        viol = self.violated_replicas(gctx, placement)
+        prio = self.replica_priority(gctx, placement, agg)
+        score = jnp.where(viol & ~gctx.replica_excluded, prio, -jnp.inf)
+        offline = currently_offline(gctx, placement)
+        return jnp.where(offline, prio + 1e30, score)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        return self.accept_replica_move(gctx, placement, agg, r, dst)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        """After the move, the destination rack stays within the pigeonhole cap."""
+        is_sib, sib_rack = _sibling_info(gctx, placement, r)
+        dst_rack = gctx.state.rack[jnp.asarray(dst)]
+        dst_count = jnp.sum((is_sib & (sib_rack == dst_rack[..., None])).astype(jnp.int32),
+                            axis=-1)
+        return dst_count + 1 <= self._rack_cap(gctx, r)
+
+    def stats_metric(self, gctx, placement, agg):
+        return jnp.sum(self.violated_replicas(gctx, placement).astype(jnp.float32))

@@ -1,0 +1,133 @@
+"""Goal registry: reference class names → goal factories.
+
+Reference: goal instantiation by priority in ``analyzer/AnalyzerUtils.java``
+``getGoalsByPriority`` :200 and the config lists in
+``config/cruisecontrol.properties:99-108`` — the ``goals`` /
+``default.goals`` / ``hard.goals`` / ``anomaly.detection.goals`` /
+``intra.broker.goals`` switch-in point the new framework must honor
+(BASELINE.json north star).  Both bare names and fully-qualified Java class
+names resolve.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.capacity import (
+    CpuCapacityGoal,
+    DiskCapacityGoal,
+    IntraBrokerDiskCapacityGoal,
+    NetworkInboundCapacityGoal,
+    NetworkOutboundCapacityGoal,
+    ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.counts import (
+    LeaderReplicaDistributionGoal,
+    ReplicaDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.goals.disk import IntraBrokerDiskUsageDistributionGoal
+from cruise_control_tpu.analyzer.goals.distribution import (
+    CpuUsageDistributionGoal,
+    DiskUsageDistributionGoal,
+    LeaderBytesInDistributionGoal,
+    NetworkInboundUsageDistributionGoal,
+    NetworkOutboundUsageDistributionGoal,
+    PotentialNwOutGoal,
+)
+from cruise_control_tpu.analyzer.goals.kafka_assigner import (
+    KafkaAssignerDiskUsageDistributionGoal,
+    KafkaAssignerEvenRackAwareGoal,
+)
+from cruise_control_tpu.analyzer.goals.leadership import (
+    MinTopicLeadersPerBrokerGoal,
+    PreferredLeaderElectionGoal,
+)
+from cruise_control_tpu.analyzer.goals.rack import (
+    RackAwareDistributionGoal,
+    RackAwareGoal,
+)
+
+_FACTORIES: Dict[str, Callable[[], Goal]] = {
+    "RackAwareGoal": RackAwareGoal,
+    "RackAwareDistributionGoal": RackAwareDistributionGoal,
+    "MinTopicLeadersPerBrokerGoal": MinTopicLeadersPerBrokerGoal,
+    "ReplicaCapacityGoal": ReplicaCapacityGoal,
+    "DiskCapacityGoal": DiskCapacityGoal,
+    "NetworkInboundCapacityGoal": NetworkInboundCapacityGoal,
+    "NetworkOutboundCapacityGoal": NetworkOutboundCapacityGoal,
+    "CpuCapacityGoal": CpuCapacityGoal,
+    "ReplicaDistributionGoal": ReplicaDistributionGoal,
+    "PotentialNwOutGoal": PotentialNwOutGoal,
+    "DiskUsageDistributionGoal": DiskUsageDistributionGoal,
+    "NetworkInboundUsageDistributionGoal": NetworkInboundUsageDistributionGoal,
+    "NetworkOutboundUsageDistributionGoal": NetworkOutboundUsageDistributionGoal,
+    "CpuUsageDistributionGoal": CpuUsageDistributionGoal,
+    "TopicReplicaDistributionGoal": TopicReplicaDistributionGoal,
+    "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
+    "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
+    "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
+    "IntraBrokerDiskCapacityGoal": IntraBrokerDiskCapacityGoal,
+    "IntraBrokerDiskUsageDistributionGoal": IntraBrokerDiskUsageDistributionGoal,
+    "KafkaAssignerEvenRackAwareGoal": KafkaAssignerEvenRackAwareGoal,
+    "KafkaAssignerDiskUsageDistributionGoal": KafkaAssignerDiskUsageDistributionGoal,
+}
+
+# Priority order per config/cruisecontrol.properties:99 (default.goals).
+DEFAULT_GOALS: List[str] = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+# config/cruisecontrol.properties:108.
+DEFAULT_HARD_GOALS: List[str] = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+]
+
+# config/cruisecontrol.properties:214.
+DEFAULT_ANOMALY_DETECTION_GOALS: List[str] = list(DEFAULT_HARD_GOALS)
+
+# config/cruisecontrol.properties:105.
+DEFAULT_INTRA_BROKER_GOALS: List[str] = [
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+]
+
+# The full supported list (config/cruisecontrol.properties:102 `goals`).
+SUPPORTED_GOALS: List[str] = list(_FACTORIES)
+
+
+def _bare(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def goal_by_name(name: str) -> Goal:
+    bare = _bare(name)
+    try:
+        return _FACTORIES[bare]()
+    except KeyError:
+        raise ValueError(f"unknown goal: {name!r} (known: {sorted(_FACTORIES)})") from None
+
+
+def get_goals_by_priority(names: Sequence[str] | None = None) -> List[Goal]:
+    """Instantiate goals in priority order (AnalyzerUtils.getGoalsByPriority)."""
+    return [goal_by_name(n) for n in (names or DEFAULT_GOALS)]

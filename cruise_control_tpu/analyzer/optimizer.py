@@ -1,0 +1,213 @@
+"""Goal-priority optimization loop.
+
+Reference: ``analyzer/GoalOptimizer.java`` — the core loop :415-489 runs goals
+by priority over one ClusterModel, collecting per-goal stats and the final
+proposal diff; :289-337 serves cached proposals; precompute happens on a
+background pool :137-188.  Here the loop body drives the TPU GoalSolver, and
+"precompute" is a cache keyed by (model generation, goals, options) — one
+batched solve is fast enough that a thread pool is unnecessary.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.context import build_context, compute_aggregates
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.registry import (
+    DEFAULT_GOALS,
+    get_goals_by_priority,
+)
+from cruise_control_tpu.analyzer.options import OptimizationOptions
+from cruise_control_tpu.analyzer.proposals import diff_proposals
+from cruise_control_tpu.analyzer.solver import (
+    GoalOptimizationInfo,
+    GoalSolver,
+    check_hard_goal,
+    default_solver,
+)
+from cruise_control_tpu.common.actions import ExecutionProposal, ProposalSummary
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
+from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
+
+LOG = logging.getLogger(__name__)
+
+# Balancedness weights (reference: KafkaCruiseControlUtils.java:734-762 —
+# goal-violation weights used for the balancedness score gauge).
+_BALANCEDNESS_WEIGHT_HARD = 3.0
+_BALANCEDNESS_WEIGHT_SOFT = 1.0
+
+
+@dataclass
+class OptimizerResult:
+    """Reference: ``analyzer/OptimizerResult.java``."""
+
+    proposals: List[ExecutionProposal]
+    goal_infos: List[GoalOptimizationInfo]
+    stats_before: ClusterModelStats
+    stats_after: ClusterModelStats
+    violated_goals_before: List[str]
+    violated_goals_after: List[str]
+    balancedness_score: float
+    elapsed_s: float
+    final_placement: Optional[Placement] = None
+
+    @property
+    def summary(self) -> ProposalSummary:
+        return ProposalSummary.of(self.proposals)
+
+    def to_dict(self) -> Dict:
+        s = self.summary
+        return {
+            "numInterBrokerReplicaMovements": s.num_inter_broker_replica_movements,
+            "numIntraBrokerReplicaMovements": s.num_intra_broker_replica_movements,
+            "numLeaderMovements": s.num_leadership_movements,
+            "interBrokerDataToMoveMB": s.inter_broker_data_to_move_mb,
+            "intraBrokerDataToMoveMB": s.intra_broker_data_to_move_mb,
+            "violatedGoalsBefore": self.violated_goals_before,
+            "violatedGoalsAfter": self.violated_goals_after,
+            "balancednessScore": self.balancedness_score,
+            "onDemandBalancednessScoreBefore": None,
+            "statsBefore": self.stats_before.to_dict(),
+            "statsAfter": self.stats_after.to_dict(),
+            "goals": [
+                {
+                    "goal": g.goal_name,
+                    "rounds": g.rounds,
+                    "moves": g.moves_applied,
+                    "violatedBrokersBefore": g.violated_brokers_before,
+                    "violatedBrokersAfter": g.violated_brokers_after,
+                    "metricBefore": g.metric_before,
+                    "metricAfter": g.metric_after,
+                }
+                for g in self.goal_infos
+            ],
+        }
+
+
+def balancedness_score(goal_infos: Sequence[GoalOptimizationInfo],
+                       goals: Sequence[Goal]) -> float:
+    """[0, 100]: weighted fraction of satisfied goals (hard goals weigh 3×)."""
+    by_name = {g.name: g for g in goals}
+    total = 0.0
+    got = 0.0
+    for info in goal_infos:
+        goal = by_name.get(info.goal_name)
+        w = _BALANCEDNESS_WEIGHT_HARD if goal is not None and goal.is_hard \
+            else _BALANCEDNESS_WEIGHT_SOFT
+        total += w
+        if info.violated_brokers_after == 0:
+            got += w
+    return 100.0 * got / total if total else 100.0
+
+
+class GoalOptimizer:
+    """Runs a prioritized goal list over a frozen snapshot; caches the last
+    result per model generation (GoalOptimizer.java:196-224 cache semantics)."""
+
+    def __init__(
+        self,
+        constraint: Optional[BalancingConstraint] = None,
+        goal_names: Optional[Sequence[str]] = None,
+        solver: Optional[GoalSolver] = None,
+    ):
+        self.constraint = constraint or BalancingConstraint()
+        self.goal_names = list(goal_names or DEFAULT_GOALS)
+        if solver is not None:
+            self.solver = solver
+        elif (self.constraint.max_candidates_per_round == 1024
+              and self.constraint.max_rounds_per_goal == 64):
+            self.solver = default_solver()
+        else:
+            self.solver = GoalSolver(
+                max_candidates_per_round=self.constraint.max_candidates_per_round,
+                max_rounds_per_goal=self.constraint.max_rounds_per_goal,
+            )
+        self._cache_lock = threading.Lock()
+        self._cached: Dict[Tuple, OptimizerResult] = {}
+
+    # ------------------------------------------------------------- the loop
+
+    def optimizations(
+        self,
+        state: ClusterState,
+        placement: Placement,
+        meta: ClusterMeta,
+        options: Optional[OptimizationOptions] = None,
+        goals: Optional[Sequence[Goal]] = None,
+        model_generation: Optional[int] = None,
+    ) -> OptimizerResult:
+        """The core loop (GoalOptimizer.java:415-489): per-goal optimize with
+        all previously-optimized goals enforcing acceptance, then diff."""
+        options = options or OptimizationOptions()
+        cache_key = None
+        if model_generation is not None:
+            effective_names = (tuple(g.name for g in goals) if goals is not None
+                               else tuple(self.goal_names))
+            cache_key = (model_generation, effective_names, options)
+            with self._cache_lock:
+                hit = self._cached.get(cache_key)
+            if hit is not None:
+                return hit
+
+        goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
+        t0 = time.monotonic()
+        gctx = build_context(state, placement, meta, self.constraint, options)
+        initial = placement
+
+        agg0 = compute_aggregates(gctx, placement)
+        violated_before = [
+            g.name for g in goals
+            if int(np.sum(np.asarray(g.violated_brokers(gctx, placement, agg0)))) > 0
+        ]
+        stats_before = compute_stats(state, placement, self.constraint.balance_threshold)
+
+        infos: List[GoalOptimizationInfo] = []
+        priors: List[Goal] = []
+        for goal in goals:
+            placement, info = self.solver.optimize_goal(goal, priors, gctx, placement)
+            infos.append(info)
+            stranded = 0
+            if goal.is_hard and goal.uses_replica_moves:
+                # Goals that cannot relocate replicas across brokers (intra-disk,
+                # leadership-only) are not responsible for dead-broker evacuation.
+                from cruise_control_tpu.analyzer.context import currently_offline
+                stranded = int(np.sum(np.asarray(
+                    currently_offline(gctx, placement))))
+            check_hard_goal(goal, info, stranded)
+            if info.metric_after > info.metric_before and info.rounds > 0:
+                # AbstractGoal.java:108-117: stats must not get worse.
+                LOG.warning("goal %s metric worsened: %.6g -> %.6g",
+                            goal.name, info.metric_before, info.metric_after)
+            priors.append(goal)
+
+        aggN = compute_aggregates(gctx, placement)
+        violated_after = [
+            g.name for g in goals
+            if int(np.sum(np.asarray(g.violated_brokers(gctx, placement, aggN)))) > 0
+        ]
+        stats_after = compute_stats(state, placement, self.constraint.balance_threshold)
+        proposals = diff_proposals(state, initial, placement, meta)
+
+        result = OptimizerResult(
+            proposals=proposals,
+            goal_infos=infos,
+            stats_before=stats_before,
+            stats_after=stats_after,
+            violated_goals_before=violated_before,
+            violated_goals_after=violated_after,
+            balancedness_score=balancedness_score(infos, goals),
+            elapsed_s=time.monotonic() - t0,
+            final_placement=placement,
+        )
+        if cache_key is not None:
+            with self._cache_lock:
+                self._cached = {cache_key: result}   # keep only latest generation
+        return result

@@ -1,0 +1,86 @@
+"""Placement diff → execution proposals.
+
+Reference: ``analyzer/AnalyzerUtils.getDiff`` :50-117 — compare the initial
+replica distribution + leadership against the optimized ClusterModel and emit
+one ``ExecutionProposal`` per changed partition, new leader first.
+
+Host-side and vectorized with numpy: one pass over the changed-partition set,
+no per-replica Python in the common (unchanged) case.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.actions import (
+    ExecutionProposal,
+    ReplicaPlacementInfo,
+    TopicPartition,
+)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
+
+
+def diff_proposals(
+    state: ClusterState,
+    initial: Placement,
+    final: Placement,
+    meta: ClusterMeta,
+) -> List[ExecutionProposal]:
+    """Proposals for every partition whose placement or leadership changed."""
+    n = meta.num_replicas
+    part = np.asarray(state.partition)[:n]
+    pos = np.asarray(state.pos)[:n]
+    disk_size = np.asarray(state.leader_load)[:n, Resource.DISK]
+    has_disks = np.asarray(state.disk_capacity).shape[1] > 1
+
+    b0 = np.asarray(initial.broker)[:n]
+    b1 = np.asarray(final.broker)[:n]
+    d0 = np.asarray(initial.disk)[:n]
+    d1 = np.asarray(final.disk)[:n]
+    l0 = np.asarray(initial.is_leader)[:n]
+    l1 = np.asarray(final.is_leader)[:n]
+
+    changed = (b0 != b1) | (l0 != l1) | (has_disks & (d0 != d1))
+    changed_parts = np.unique(part[changed])
+    if changed_parts.size == 0:
+        return []
+
+    # Group replica rows by partition, ordered by (partition, pos).
+    order = np.lexsort((pos, part))
+    sorted_part = part[order]
+    starts = np.searchsorted(sorted_part, changed_parts, side="left")
+    ends = np.searchsorted(sorted_part, changed_parts, side="right")
+
+    broker_ids = np.asarray(meta.broker_ids)
+    proposals: List[ExecutionProposal] = []
+    for p, s, e in zip(changed_parts.tolist(), starts.tolist(), ends.tolist()):
+        rows = order[s:e]
+        t_idx, p_num = meta.partitions[p]
+        tp = TopicPartition(meta.topics[t_idx], p_num)
+
+        def placement_info(r: int, brokers, disks) -> ReplicaPlacementInfo:
+            return ReplicaPlacementInfo(
+                int(broker_ids[brokers[r]]),
+                int(disks[r]) if has_disks else None)
+
+        old_list = [placement_info(r, b0, d0) for r in rows]
+        old_leader_rows = [r for r in rows if l0[r]]
+        old_leader = (placement_info(old_leader_rows[0], b0, d0)
+                      if old_leader_rows else old_list[0])
+
+        new_leader_rows = [r for r in rows if l1[r]]
+        lead_row = new_leader_rows[0] if new_leader_rows else rows[0]
+        new_list = ([placement_info(lead_row, b1, d1)]
+                    + [placement_info(r, b1, d1) for r in rows if r != lead_row])
+
+        proposals.append(ExecutionProposal(
+            topic_partition=tp,
+            partition_size=float(disk_size[rows].max(initial=0.0)),
+            old_leader=old_leader,
+            old_replicas=tuple(old_list),
+            new_replicas=tuple(new_list),
+        ))
+    return proposals

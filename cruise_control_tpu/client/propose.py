@@ -1,0 +1,41 @@
+"""``tpucc propose`` — the offline end-to-end slice.
+
+Snapshot file → tensors → GoalOptimizer → proposals printed as JSON
+(SURVEY.md §7 step 4: the first milestone and parity gate; reference flow is
+``POST /rebalance?dryrun=true`` via RebalanceRunnable → GoalOptimizer).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def run_propose(args) -> int:
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.common.exceptions import OptimizationFailureError
+    from cruise_control_tpu.model import snapshot as snap
+
+    if args.snapshot.endswith(".npz"):
+        state, placement, meta = snap.load_npz(args.snapshot)
+    else:
+        cm = snap.load_json(args.snapshot)
+        state, placement, meta = cm.freeze()
+
+    goal_names = args.goals.split(",") if args.goals else None
+    optimizer = GoalOptimizer(goal_names=goal_names)
+    try:
+        result = optimizer.optimizations(state, placement, meta)
+    except OptimizationFailureError as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return 2
+
+    out = {
+        "proposals": [p.to_dict() for p in result.proposals],
+        "summary": result.to_dict(),
+        "elapsedSeconds": result.elapsed_s,
+    }
+    if not getattr(args, "verbose", False):
+        out.pop("summary")
+    print(json.dumps(out, indent=2))
+    return 0

@@ -1,0 +1,154 @@
+"""Cluster snapshot serialization.
+
+The host↔solver boundary format (SURVEY.md §5: {replica loads f32[R,4],
+assignment i32[R], leader mask, rack ids, capacities, masks}).  Two codecs:
+
+- JSON — human-readable, used by the ``tpucc propose`` CLI and tests; schema
+  mirrors what the reference's ``load`` endpoint emits (brokers + partitions
+  with per-resource loads).
+- NPZ  — zero-copy numpy bundle for large snapshots (1M replicas packs in
+  ~100 MB and loads in milliseconds).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement, make_state
+
+_RES_KEYS = ("cpu", "networkInbound", "networkOutbound", "disk")
+
+
+def model_to_json_dict(cm: ClusterModel) -> Dict:
+    brokers = []
+    for b in cm.brokers():
+        brokers.append({
+            "brokerId": b.broker_id,
+            "rack": b.rack,
+            "host": b.host,
+            "alive": b.alive,
+            "newBroker": b.new_broker,
+            "capacity": {k: float(b.capacity[i]) for i, k in enumerate(_RES_KEYS)},
+            "diskCapacities": [float(x) for x in b.disk_capacities],
+            "diskAlive": [bool(x) for x in b.disk_alive],
+        })
+    partitions = []
+    for (topic, part), replicas in cm.partitions().items():
+        partitions.append({
+            "topic": topic,
+            "partition": part,
+            "replicas": [{
+                "brokerId": r.broker_id,
+                "isLeader": r.is_leader,
+                "disk": r.disk,
+                "load": {k: float(r.leader_load[i]) for i, k in enumerate(_RES_KEYS)},
+                "followerLoad": (None if r.follower_load is None else
+                                 {k: float(r.follower_load[i])
+                                  for i, k in enumerate(_RES_KEYS)}),
+            } for r in replicas],
+        })
+    return {"version": 1, "brokers": brokers, "partitions": partitions}
+
+
+def model_from_json_dict(doc: Dict) -> ClusterModel:
+    cm = ClusterModel()
+    for b in doc["brokers"]:
+        cap = {Resource.from_name(k): v for k, v in b["capacity"].items()}
+        disks = b.get("diskCapacities")
+        cm.create_broker(rack=b["rack"], host=b.get("host", f"h{b['brokerId']}"),
+                         broker_id=b["brokerId"], capacity=cap,
+                         disk_capacities=disks if disks and len(disks) > 1 else None,
+                         new_broker=b.get("newBroker", False))
+    for p in doc["partitions"]:
+        for i, r in enumerate(p["replicas"]):
+            cm.create_replica(p["topic"], p["partition"], broker_id=r["brokerId"],
+                              index=i, is_leader=r["isLeader"], disk=r.get("disk", 0))
+            load = [r["load"][k] for k in _RES_KEYS]
+            fl = r.get("followerLoad")
+            cm.set_replica_load(p["topic"], p["partition"], r["brokerId"], load,
+                                follower_load=None if fl is None
+                                else [fl[k] for k in _RES_KEYS])
+    # Dead brokers: applied after replicas exist so offline flags propagate.
+    for b in doc["brokers"]:
+        if not b.get("alive", True):
+            cm.set_broker_state(b["brokerId"], alive=False)
+        for d, ok in enumerate(b.get("diskAlive", [])):
+            if not ok:
+                cm.mark_disk_dead(b["brokerId"], d)
+    return cm
+
+
+def save_json(cm: ClusterModel, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(model_to_json_dict(cm), f)
+
+
+def load_json(path: str) -> ClusterModel:
+    with open(path) as f:
+        return model_from_json_dict(json.load(f))
+
+
+# ------------------------------------------------------------------ NPZ codec
+
+
+def save_npz(path: str, state: ClusterState, placement: Placement,
+             meta: ClusterMeta) -> None:
+    np.savez_compressed(
+        path,
+        leader_load=np.asarray(state.leader_load),
+        follower_load=np.asarray(state.follower_load),
+        partition=np.asarray(state.partition),
+        topic=np.asarray(state.topic),
+        pos=np.asarray(state.pos),
+        orig_broker=np.asarray(state.orig_broker),
+        offline=np.asarray(state.offline),
+        valid=np.asarray(state.valid),
+        capacity=np.asarray(state.capacity),
+        host=np.asarray(state.host),
+        rack=np.asarray(state.rack),
+        alive=np.asarray(state.alive),
+        new_broker=np.asarray(state.new_broker),
+        broker_valid=np.asarray(state.broker_valid),
+        disk_capacity=np.asarray(state.disk_capacity),
+        disk_alive=np.asarray(state.disk_alive),
+        assignment=np.asarray(placement.broker),
+        disk=np.asarray(placement.disk),
+        is_leader=np.asarray(placement.is_leader),
+        meta_broker_ids=np.asarray(meta.broker_ids),
+        meta_topics=np.asarray(meta.topics),
+        meta_partitions=np.asarray(meta.partitions),
+        meta_racks=np.asarray(meta.racks),
+        meta_hosts=np.asarray(meta.hosts),
+        meta_counts=np.asarray([meta.num_replicas, meta.num_brokers]),
+    )
+
+
+def load_npz(path: str) -> Tuple[ClusterState, Placement, ClusterMeta]:
+    z = np.load(path, allow_pickle=False)
+    n_r, n_b = (int(x) for x in z["meta_counts"])
+    arrays = {k: z[k][:n_r] if z[k].shape[:1] == z["valid"].shape else z[k]
+              for k in ("leader_load", "follower_load", "partition", "topic", "pos",
+                        "orig_broker", "offline", "assignment", "disk", "is_leader")}
+    for k in ("capacity", "host", "rack", "alive", "new_broker",
+              "disk_capacity", "disk_alive"):
+        arrays[k] = z[k][:n_b]
+    # Trim replica-axis arrays to the true count (they were saved padded).
+    for k in ("leader_load", "follower_load", "partition", "topic", "pos",
+              "orig_broker", "offline", "assignment", "disk", "is_leader"):
+        arrays[k] = np.asarray(arrays[k])[:n_r]
+    state, placement = make_state(arrays)
+    mp = z["meta_partitions"]
+    meta = ClusterMeta(
+        broker_ids=[int(x) for x in z["meta_broker_ids"]],
+        topics=[str(x) for x in z["meta_topics"]],
+        partitions=[(int(a), int(b)) for a, b in mp],
+        racks=[str(x) for x in z["meta_racks"]],
+        hosts=[str(x) for x in z["meta_hosts"]],
+        num_replicas=n_r, num_brokers=n_b,
+    )
+    return state, placement, meta

@@ -2,11 +2,28 @@
 
 Multi-chip TPU hardware is unavailable in CI; sharding correctness is validated
 on host-platform virtual devices instead.  Must run before the first jax import.
+
+Two traps this guards against:
+- ``JAX_PLATFORMS`` is preset to ``axon`` in the environment, so ``setdefault``
+  would silently leave tests running on the real TPU chip.
+- The axon PJRT plugin registers at interpreter start (sitecustomize) and
+  ``jax.backends()`` initializes *every* registered plugin regardless of
+  ``JAX_PLATFORMS`` — if the TPU tunnel is down, that init hangs forever.
+  Deregistering the factory before the first backend lookup keeps tests
+  hermetic and CPU-only.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# sitecustomize imported jax before this file ran, so the config already
+# captured JAX_PLATFORMS=axon — override it through the config API too.
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)

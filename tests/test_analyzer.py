@@ -1,0 +1,242 @@
+"""Analyzer tests on deterministic fixtures.
+
+Models the reference's ``analyzer/DeterministicClusterTest.java`` (goal lists
+run over hand-built models, outcomes asserted) with
+``OptimizationVerifier``-style postcondition checks
+(``testing/verifier.py``).
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import BalancingConstraint, GoalOptimizer, OptimizationOptions
+from cruise_control_tpu.analyzer.context import build_context, compute_aggregates
+from cruise_control_tpu.analyzer.goals.registry import goal_by_name
+from cruise_control_tpu.common.exceptions import OptimizationFailureError
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import ops
+from cruise_control_tpu.testing import deterministic as det
+from cruise_control_tpu.testing.verifier import execute_goals_for
+
+PAD_R, PAD_B = 64, 8
+
+HARD_GOALS = [
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+]
+
+
+def freeze(cm):
+    return cm.freeze(pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+
+
+def test_unbalanced_capacity_fixed():
+    """unbalanced(): both 1-replica partitions sit on broker 0 at half-capacity
+    load each; capacity goals must split them."""
+    state, placement, meta = freeze(det.unbalanced())
+    report = execute_goals_for(state, placement, meta, HARD_GOALS)
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    bl = np.asarray(ops.broker_load(state, final))
+    # No broker above capacity threshold for any resource.
+    cap = np.asarray(state.capacity)
+    thresh = BalancingConstraint().capacity_threshold
+    alive = np.asarray(state.alive & state.broker_valid)
+    assert (bl[alive] <= cap[alive] * thresh + 1e-3).all()
+    assert len(report.result.proposals) >= 1
+
+
+def test_rack_aware_satisfiable():
+    """Two replicas on the same rack get separated."""
+    state, placement, meta = freeze(det.rack_aware_satisfiable())
+    report = execute_goals_for(state, placement, meta, ["RackAwareGoal"])
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    rack = np.asarray(state.rack)
+    brokers = np.asarray(final.broker)[:meta.num_replicas]
+    assert rack[brokers[0]] != rack[brokers[1]]
+
+
+def test_rack_aware_already_satisfied_no_moves():
+    state, placement, meta = freeze(det.rack_aware_satisfiable2())
+    report = execute_goals_for(state, placement, meta, ["RackAwareGoal"])
+    assert report.ok
+    assert len(report.result.proposals) == 0
+
+
+def test_rack_aware_unsatisfiable_raises():
+    """3 replicas, 2 racks — strict rack-awareness must fail."""
+    state, placement, meta = freeze(det.rack_aware_unsatisfiable())
+    with pytest.raises(OptimizationFailureError):
+        execute_goals_for(state, placement, meta, ["RackAwareGoal"])
+
+
+def test_rack_aware_distribution_allows_pigeonhole():
+    """The relaxed goal accepts 3 replicas / 2 racks as long as the spread is
+    even (2+1), like RackAwareDistributionGoal.java."""
+    state, placement, meta = freeze(det.rack_aware_unsatisfiable())
+    report = execute_goals_for(state, placement, meta, ["RackAwareDistributionGoal"])
+    assert report.ok, report.failures
+
+
+def test_dead_broker_replicas_move():
+    """Killing a broker strands replicas; hard goals must relocate them all
+    (4 brokers / 2 racks so a rack-aware destination exists)."""
+    cm = det.homogeneous_cluster(det.RACK_BY_BROKER3)
+    cm.create_replica(det.T1, 0, broker_id=0, index=0, is_leader=True)
+    cm.create_replica(det.T1, 0, broker_id=1, index=1, is_leader=False)
+    cm.set_replica_load(det.T1, 0, 0, det.load(40.0, 100.0, 130.0, 75.0))
+    cm.set_replica_load(det.T1, 0, 1, det.load(5.0, 100.0, 0.0, 75.0))
+    cm.set_broker_state(1, alive=False)
+    state, placement, meta = freeze(cm)
+    report = execute_goals_for(
+        state, placement, meta, HARD_GOALS,
+        verifications=("GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION"))
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    alive = np.asarray(state.alive)
+    valid = np.asarray(state.valid)
+    assert alive[np.asarray(final.broker)[valid]].all()
+
+
+def test_replica_distribution_balances_counts():
+    """unbalanced2(): 6 single-replica partitions, 5 on broker 0."""
+    state, placement, meta = freeze(det.unbalanced2())
+    report = execute_goals_for(state, placement, meta, ["ReplicaDistributionGoal"])
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    counts = np.asarray(ops.replica_counts(state, final))[:meta.num_brokers]
+    assert counts.max() - counts.min() <= 2
+    assert counts.max() <= 3
+
+
+def test_preferred_leader_election():
+    """unbalanced3(): leaders at replica-list position 1 move to position 0."""
+    state, placement, meta = freeze(det.unbalanced3())
+    report = execute_goals_for(state, placement, meta, ["PreferredLeaderElectionGoal"],
+                               verifications=())
+    final = report.result.final_placement
+    pos = np.asarray(state.pos)
+    lead = np.asarray(final.is_leader)
+    valid = np.asarray(state.valid)
+    assert (pos[lead & valid] == 0).all()
+    # Both partitions changed leadership → leadership-only proposals.
+    assert len(report.result.proposals) == 2
+    for p in report.result.proposals:
+        assert p.has_leader_action and not p.has_replica_action
+
+
+def test_excluded_topics_stay_put():
+    state, placement, meta = freeze(det.unbalanced())
+    opts = OptimizationOptions(excluded_topics=frozenset({"T1", "T2"}))
+    optimizer = GoalOptimizer(goal_names=["ReplicaDistributionGoal"])
+    res = optimizer.optimizations(state, placement, meta, options=opts)
+    assert len(res.proposals) == 0
+
+
+def test_excluded_brokers_for_replica_move():
+    state, placement, meta = freeze(det.unbalanced())
+    opts = OptimizationOptions(excluded_brokers_for_replica_move=frozenset({1, 2}))
+    optimizer = GoalOptimizer(goal_names=["ReplicaDistributionGoal"])
+    res = optimizer.optimizations(state, placement, meta, options=opts)
+    # Both other brokers excluded → nothing can move.
+    assert len(res.proposals) == 0
+
+
+def test_requested_destination_brokers():
+    state, placement, meta = freeze(det.unbalanced())
+    opts = OptimizationOptions(requested_destination_broker_ids=frozenset({2}))
+    optimizer = GoalOptimizer(goal_names=["ReplicaDistributionGoal"])
+    res = optimizer.optimizations(state, placement, meta, options=opts)
+    for p in res.proposals:
+        added = {r.broker_id for r in p.replicas_to_add}
+        assert added <= {2}
+
+
+def test_proposals_apply_back_to_model():
+    """Diff → proposals → builder apply_placement round-trip stays consistent."""
+    cm = det.unbalanced2()
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+    state, placement, meta = freeze(cm)
+    report = execute_goals_for(state, placement, meta, goals)
+    assert report.ok, report.failures
+    cm.apply_placement(report.result.final_placement, meta)
+    state2, placement2, meta2 = freeze(cm)
+    # Re-running the same goals on the optimized model produces no proposals.
+    report2 = execute_goals_for(state2, placement2, meta2, goals)
+    assert report2.ok
+    assert len(report2.result.proposals) == 0
+
+
+def test_unbalanced2_capacity_infeasible():
+    """unbalanced2 carries 6 half-capacity replicas over 3 brokers — more disk
+    than the 0.8 capacity threshold can host; the hard goal must fail loudly."""
+    state, placement, meta = freeze(det.unbalanced2())
+    with pytest.raises(OptimizationFailureError):
+        execute_goals_for(state, placement, meta, ["DiskCapacityGoal"])
+
+
+def test_balancedness_score_improves():
+    state, placement, meta = freeze(det.unbalanced())
+    optimizer = GoalOptimizer()
+    res = optimizer.optimizations(state, placement, meta)
+    assert 0.0 <= res.balancedness_score <= 100.0
+    assert len(res.violated_goals_after) <= len(res.violated_goals_before)
+
+
+def test_proposal_cache_by_generation():
+    state, placement, meta = freeze(det.unbalanced())
+    optimizer = GoalOptimizer(goal_names=["ReplicaDistributionGoal"])
+    r1 = optimizer.optimizations(state, placement, meta, model_generation=7)
+    r2 = optimizer.optimizations(state, placement, meta, model_generation=7)
+    assert r1 is r2
+    r3 = optimizer.optimizations(state, placement, meta, model_generation=8)
+    assert r3 is not r1
+
+
+def test_incremental_aggregates_match_recompute():
+    """apply_replica_move / apply_leadership_move scatter updates must agree
+    with a full compute_aggregates recompute (solver-carry drift check)."""
+    import jax.tree_util as jtu
+
+    from cruise_control_tpu.analyzer.context import (
+        apply_leadership_move,
+        apply_replica_move,
+    )
+
+    state, placement, meta = freeze(det.unbalanced_with_a_follower())
+    gctx = build_context(state, placement, meta, BalancingConstraint(),
+                         OptimizationOptions())
+    agg = compute_aggregates(gctx, placement)
+    # Move replica 0 (leader of T1-0 on broker 0) to broker 1, disk 0.
+    placement2, agg2 = apply_replica_move(gctx, placement, agg, 0, 1, 0)
+    fresh = compute_aggregates(gctx, placement2)
+    for got, want in zip(jtu.tree_leaves(agg2), jtu.tree_leaves(fresh)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-3)
+    # Promote the follower of T1-0 (now the only other replica of p0).
+    follower = int(np.nonzero(
+        (np.asarray(state.partition) == 0) & ~np.asarray(placement2.is_leader)
+        & np.asarray(state.valid))[0][0])
+    placement3, agg3 = apply_leadership_move(gctx, placement2, agg2, follower)
+    fresh3 = compute_aggregates(gctx, placement3)
+    for got, want in zip(jtu.tree_leaves(agg3), jtu.tree_leaves(fresh3)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-3)
+
+
+def test_intra_broker_disk_balance():
+    """unbalanced4(): JBOD brokers with skewed logdirs; the intra-broker goals
+    move replicas between disks of the same broker only."""
+    state, placement, meta = freeze(det.unbalanced4())
+    constraint = BalancingConstraint()
+    constraint.capacity_threshold = np.array([0.7, 0.8, 0.8, 0.95], dtype=np.float32)
+    report = execute_goals_for(
+        state, placement, meta,
+        ["IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"],
+        constraint=constraint,
+        verifications=("GOAL_VIOLATION",))
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    # Broker assignment untouched; only disks may change.
+    assert (np.asarray(final.broker) == np.asarray(placement.broker)).all()
