@@ -1,0 +1,32 @@
+"""Monitor layer: samples → windowed aggregates → cluster model snapshots.
+
+TPU-native replacement for the reference monitor
+(``monitor/LoadMonitor.java``, ``monitor/sampling/**`` and the core
+``MetricSampleAggregator`` framework): ring buffers become dense
+``f32[E, W, M]`` arrays with count/validity planes, extrapolations become
+vectorized masks, and the output is the frozen SoA snapshot the analyzer
+consumes directly.
+"""
+
+from cruise_control_tpu.monitor.metric_def import MetricDef, ValueComputingStrategy
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    MetricSampleAggregator,
+    MetricSampleCompleteness,
+)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    ModelCompletenessRequirements,
+)
+
+__all__ = [
+    "MetricDef",
+    "ValueComputingStrategy",
+    "MetricSampleAggregator",
+    "AggregationOptions",
+    "MetricSampleCompleteness",
+    "Extrapolation",
+    "LoadMonitor",
+    "ModelCompletenessRequirements",
+]

@@ -1,0 +1,106 @@
+"""Broker capacity resolution.
+
+Reference: ``config/BrokerCapacityConfigResolver.java`` SPI and
+``config/BrokerCapacityConfigFileResolver.java`` (JSON file with per-broker
+overrides, JBOD logdir capacities, num cores; broker id -1 is the default
+entry; capacities may be flagged as estimated).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+
+DEFAULT_CAPACITY_BROKER_ID = -1
+
+
+@dataclass
+class BrokerCapacityInfo:
+    capacity: np.ndarray                     # f64[4]
+    disk_capacities: Optional[List[float]] = None   # JBOD logdirs
+    num_cores: int = 1
+    estimated: bool = False
+    estimation_info: str = ""
+
+
+class BrokerCapacityConfigResolver(Protocol):
+    def capacity_for_broker(self, rack: str, host: str, broker_id: int,
+                            allow_estimation: bool = True) -> BrokerCapacityInfo: ...
+
+
+class FixedBrokerCapacityResolver:
+    """Same capacity for every broker (tests / homogeneous clusters)."""
+
+    def __init__(self, capacity: Dict[Resource, float],
+                 disk_capacities: Optional[List[float]] = None,
+                 num_cores: int = 1):
+        arr = np.zeros(NUM_RESOURCES)
+        for k, v in capacity.items():
+            arr[int(k)] = v
+        self._info = BrokerCapacityInfo(capacity=arr,
+                                        disk_capacities=disk_capacities,
+                                        num_cores=num_cores)
+
+    def capacity_for_broker(self, rack: str, host: str, broker_id: int,
+                            allow_estimation: bool = True) -> BrokerCapacityInfo:
+        return self._info
+
+
+class BrokerCapacityConfigFileResolver:
+    """JSON-file resolver (BrokerCapacityConfigFileResolver.java:1-333).
+
+    File schema (mirrors the reference's capacity.json family)::
+
+        {"brokerCapacities": [
+           {"brokerId": -1, "capacity": {"CPU": "100", "NW_IN": "...",
+            "NW_OUT": "...", "DISK": "..."}},                       # default
+           {"brokerId": 0,  "capacity": {"DISK": {"/mnt/i01": "250000",
+            "/mnt/i02": "250000"}, ...}, "numCores": 8},            # override
+        ]}
+    """
+
+    _KEYS = {"CPU": Resource.CPU, "NW_IN": Resource.NW_IN,
+             "NW_OUT": Resource.NW_OUT, "DISK": Resource.DISK}
+
+    def __init__(self, path: str):
+        with open(path) as f:
+            doc = json.load(f)
+        self._by_broker: Dict[int, BrokerCapacityInfo] = {}
+        for entry in doc.get("brokerCapacities", []):
+            bid = int(entry["brokerId"])
+            cap = np.zeros(NUM_RESOURCES)
+            disks: Optional[List[float]] = None
+            for key, val in entry.get("capacity", {}).items():
+                res = self._KEYS[key]
+                if isinstance(val, dict):   # JBOD: logdir -> capacity
+                    disks = [float(v) for v in val.values()]
+                    cap[int(res)] = sum(disks)
+                else:
+                    cap[int(res)] = float(val)
+            self._by_broker[bid] = BrokerCapacityInfo(
+                capacity=cap, disk_capacities=disks,
+                num_cores=int(entry.get("numCores", 1)),
+                estimated=bid == DEFAULT_CAPACITY_BROKER_ID,
+                estimation_info=("default capacity entry"
+                                 if bid == DEFAULT_CAPACITY_BROKER_ID else ""))
+        if DEFAULT_CAPACITY_BROKER_ID not in self._by_broker:
+            raise ValueError(
+                f"capacity config must define the default entry "
+                f"(brokerId={DEFAULT_CAPACITY_BROKER_ID})")
+
+    def capacity_for_broker(self, rack: str, host: str, broker_id: int,
+                            allow_estimation: bool = True) -> BrokerCapacityInfo:
+        info = self._by_broker.get(broker_id)
+        if info is not None:
+            return info
+        default = self._by_broker[DEFAULT_CAPACITY_BROKER_ID]
+        if not allow_estimation:
+            raise ValueError(
+                f"no explicit capacity for broker {broker_id} and "
+                "estimation is disallowed")
+        return default

@@ -1,0 +1,227 @@
+"""LoadMonitor: samples + metadata → frozen cluster snapshots on demand.
+
+Reference: ``monitor/LoadMonitor.java:78-796`` — wiring of aggregators,
+metadata client and capacity resolver (ctor :124-191), the
+``clusterModel(from, to, requirements, …)`` path :530-582 (aggregate →
+populate capacities :477-514 → per-partition load population via
+``MonitorUtils.populatePartitionLoad`` :382-447), completeness gating
+:630-643, and the fair semaphore bounding concurrent model generations
+:378-389.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.exceptions import NotEnoughValidWindowsError
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
+from cruise_control_tpu.monitor import metric_def as md
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    MetricSampleAggregator,
+    MetricSampleCompleteness,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityConfigResolver,
+    FixedBrokerCapacityResolver,
+)
+from cruise_control_tpu.monitor.metadata import ClusterMetadata, MetadataClient
+
+
+@dataclass(frozen=True)
+class ModelCompletenessRequirements:
+    """Reference: monitor/ModelCompletenessRequirements.java."""
+
+    min_required_num_windows: int = 1
+    min_monitored_partitions_percentage: float = 0.0
+    include_all_topics: bool = False
+
+    def stronger(self, other: "ModelCompletenessRequirements"
+                 ) -> "ModelCompletenessRequirements":
+        return ModelCompletenessRequirements(
+            max(self.min_required_num_windows, other.min_required_num_windows),
+            max(self.min_monitored_partitions_percentage,
+                other.min_monitored_partitions_percentage),
+            self.include_all_topics or other.include_all_topics)
+
+
+@dataclass
+class LoadMonitorState:
+    state: str
+    num_valid_windows: int
+    monitored_partitions_percentage: float
+    total_num_partitions: int
+    generation: int
+
+    def to_dict(self) -> Dict:
+        return {
+            "state": self.state,
+            "numValidWindows": self.num_valid_windows,
+            "monitoredPartitionsPercentage":
+                round(self.monitored_partitions_percentage * 100.0, 3),
+            "totalNumPartitions": self.total_num_partitions,
+            "generation": self.generation,
+        }
+
+
+class LoadMonitor:
+    """Turns windowed samples + metadata into analyzer-ready snapshots."""
+
+    def __init__(
+        self,
+        metadata_client: MetadataClient,
+        capacity_resolver: Optional[BrokerCapacityConfigResolver] = None,
+        num_windows: int = 5,
+        window_ms: int = 300_000,
+        min_samples_per_window: int = 1,
+        max_concurrent_model_generations: int = 2,
+    ):
+        self.metadata_client = metadata_client
+        self.capacity_resolver = capacity_resolver or FixedBrokerCapacityResolver(
+            {Resource.CPU: 100.0, Resource.NW_IN: 300_000.0,
+             Resource.NW_OUT: 200_000.0, Resource.DISK: 300_000.0})
+        self.partition_aggregator = MetricSampleAggregator(
+            md.COMMON_METRIC_DEF, num_windows=num_windows, window_ms=window_ms,
+            min_samples_per_window=min_samples_per_window,
+            group_of=lambda e: e[0])     # group = topic
+        self.broker_aggregator = MetricSampleAggregator(
+            md.BROKER_METRIC_DEF, num_windows=20, window_ms=window_ms,
+            min_samples_per_window=min_samples_per_window)
+        # Fair semaphore bounding concurrent model generations (:163-166).
+        self._model_semaphore = threading.BoundedSemaphore(
+            max_concurrent_model_generations)
+        self._resource_matrix = md.COMMON_METRIC_DEF.resource_matrix()
+
+    # ---------------------------------------------------------- generation
+
+    @property
+    def model_generation(self) -> Tuple[int, int]:
+        return (self.metadata_client.generation, self.partition_aggregator.generation)
+
+    def acquire_for_model_generation(self):
+        """Context manager bounding concurrent snapshot builds."""
+        sem = self._model_semaphore
+
+        class _Ctx:
+            def __enter__(self):
+                sem.acquire()
+                return self
+
+            def __exit__(self, *exc):
+                sem.release()
+                return False
+
+        return _Ctx()
+
+    # -------------------------------------------------------- completeness
+
+    def meet_completeness_requirements(
+            self, requirements: ModelCompletenessRequirements) -> bool:
+        """Reference: LoadMonitor.meetCompletenessRequirements :630-643."""
+        now = time.time() * 1000
+        completeness = self.partition_aggregator.completeness(-float("inf"), now)
+        if len(completeness.valid_windows) < requirements.min_required_num_windows:
+            return False
+        return (completeness.valid_entity_ratio
+                >= requirements.min_monitored_partitions_percentage)
+
+    def monitored_partitions_percentage(self) -> float:
+        now = time.time() * 1000
+        completeness = self.partition_aggregator.completeness(-float("inf"), now)
+        return completeness.valid_entity_ratio
+
+    # ------------------------------------------------------- cluster model
+
+    def cluster_model(
+        self,
+        from_ms: float = -float("inf"),
+        to_ms: Optional[float] = None,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        allow_capacity_estimation: bool = True,
+        pad_replicas_to: int = 1,
+        pad_brokers_to: int = 1,
+    ) -> Tuple[ClusterState, Placement, ClusterMeta]:
+        """Build a frozen snapshot (LoadMonitor.clusterModel :530-582)."""
+        requirements = requirements or ModelCompletenessRequirements()
+        to_ms = time.time() * 1000 if to_ms is None else to_ms
+        with self.acquire_for_model_generation():
+            metadata = self.metadata_client.refresh_metadata()
+            options = AggregationOptions(
+                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+                min_valid_windows=requirements.min_required_num_windows,
+                group_granularity=requirements.include_all_topics)
+            result = self.partition_aggregator.aggregate(from_ms, to_ms, options)
+            cm = self._populate(metadata, result, allow_capacity_estimation)
+            return cm.freeze(pad_replicas_to=pad_replicas_to,
+                             pad_brokers_to=pad_brokers_to)
+
+    def cluster_model_builder(self, *args, **kwargs) -> ClusterModel:
+        """As above but returns the mutable builder (RF-change flows)."""
+        requirements = kwargs.get("requirements") or ModelCompletenessRequirements()
+        to_ms = time.time() * 1000
+        metadata = self.metadata_client.refresh_metadata()
+        options = AggregationOptions(
+            min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+            min_valid_windows=requirements.min_required_num_windows)
+        result = self.partition_aggregator.aggregate(-float("inf"), to_ms, options)
+        return self._populate(metadata, result,
+                              kwargs.get("allow_capacity_estimation", True))
+
+    def _populate(self, metadata: ClusterMetadata, agg_result,
+                  allow_capacity_estimation: bool) -> ClusterModel:
+        cm = ClusterModel()
+        broker_info = {b.broker_id: b for b in metadata.brokers}
+        for b in metadata.brokers:
+            cap = self.capacity_resolver.capacity_for_broker(
+                b.rack, b.host, b.broker_id,
+                allow_estimation=allow_capacity_estimation)
+            cm.create_broker(rack=b.rack, host=b.host, broker_id=b.broker_id,
+                             capacity={r: float(cap.capacity[int(r)])
+                                       for r in Resource},
+                             disk_capacities=cap.disk_capacities)
+        values = agg_result.values_and_extrapolations
+        mat = self._resource_matrix
+        for p in metadata.partitions:
+            if not p.replicas:
+                continue
+            for i, broker_id in enumerate(p.replicas):
+                if broker_id not in broker_info:
+                    continue
+                cm.create_replica(p.topic, p.partition, broker_id=broker_id,
+                                  index=i, is_leader=(broker_id == p.leader))
+            vae = values.get((p.topic, p.partition))
+            if vae is None:
+                continue  # not monitored; include_all_topics gate decides upstream
+            # Collapse windows per metric strategy then map to resources
+            # (Load.expectedUtilizationFor :84-98 over the window axis).
+            per_metric = vae.values.mean(axis=1)       # f32[M]
+            load = mat @ per_metric                    # f32[4]
+            leader_broker = p.leader if p.leader in broker_info else p.replicas[0]
+            if any(r.broker_id == leader_broker
+                   for r in cm.partition(p.topic, p.partition)):
+                cm.set_replica_load(p.topic, p.partition, leader_broker, load)
+        # Dead brokers last so offline flags land on populated replicas.
+        for b in metadata.brokers:
+            if not b.alive:
+                cm.set_broker_state(b.broker_id, alive=False)
+        return cm
+
+    # ---------------------------------------------------------------- state
+
+    def state(self, runner_state: str = "RUNNING") -> LoadMonitorState:
+        now = time.time() * 1000
+        completeness = self.partition_aggregator.completeness(-float("inf"), now)
+        return LoadMonitorState(
+            state=runner_state,
+            num_valid_windows=len(completeness.valid_windows),
+            monitored_partitions_percentage=completeness.valid_entity_ratio,
+            total_num_partitions=completeness.num_entities,
+            generation=self.partition_aggregator.generation,
+        )

@@ -1,0 +1,134 @@
+"""Metric samples — the monitor's unit of ingest.
+
+Reference: ``monitor/sampling/holder/PartitionMetricSample.java`` and
+``BrokerMetricSample.java`` (typed per-entity metric records with a close()
+timestamp), plus the raw wire types from the metrics-reporter module
+(``cruise-control-metrics-reporter/.../RawMetricType.java:27-120`` — 94 raw
+broker/topic/partition metric types with BROKER/TOPIC/PARTITION scopes).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Hashable, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.monitor import metric_def as md
+
+
+class RawMetricScope(enum.Enum):
+    BROKER = "broker"
+    TOPIC = "topic"
+    PARTITION = "partition"
+
+
+class RawMetricType(enum.Enum):
+    """The subset of the reporter's 94 raw types the model consumes
+    (RawMetricType.java; the rest are passthrough broker health metrics)."""
+
+    ALL_TOPIC_BYTES_IN = ("broker", 0)
+    ALL_TOPIC_BYTES_OUT = ("broker", 1)
+    ALL_TOPIC_REPLICATION_BYTES_IN = ("broker", 2)
+    ALL_TOPIC_REPLICATION_BYTES_OUT = ("broker", 3)
+    ALL_TOPIC_PRODUCE_REQUEST_RATE = ("broker", 4)
+    ALL_TOPIC_FETCH_REQUEST_RATE = ("broker", 5)
+    ALL_TOPIC_MESSAGES_IN_PER_SEC = ("broker", 6)
+    BROKER_CPU_UTIL = ("broker", 7)
+    BROKER_PRODUCE_REQUEST_RATE = ("broker", 8)
+    BROKER_CONSUMER_FETCH_REQUEST_RATE = ("broker", 9)
+    BROKER_FOLLOWER_FETCH_REQUEST_RATE = ("broker", 10)
+    BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT = ("broker", 11)
+    BROKER_REQUEST_QUEUE_SIZE = ("broker", 12)
+    BROKER_RESPONSE_QUEUE_SIZE = ("broker", 13)
+    BROKER_LOG_FLUSH_RATE = ("broker", 14)
+    BROKER_LOG_FLUSH_TIME_MS_MEAN = ("broker", 15)
+    BROKER_LOG_FLUSH_TIME_MS_MAX = ("broker", 16)
+    TOPIC_BYTES_IN = ("topic", 30)
+    TOPIC_BYTES_OUT = ("topic", 31)
+    TOPIC_REPLICATION_BYTES_IN = ("topic", 32)
+    TOPIC_REPLICATION_BYTES_OUT = ("topic", 33)
+    TOPIC_PRODUCE_REQUEST_RATE = ("topic", 34)
+    TOPIC_FETCH_REQUEST_RATE = ("topic", 35)
+    TOPIC_MESSAGES_IN_PER_SEC = ("topic", 36)
+    PARTITION_SIZE = ("partition", 60)
+
+    @property
+    def scope(self) -> RawMetricScope:
+        return RawMetricScope(self.value[0])
+
+
+@dataclass
+class CruiseControlMetric:
+    """One raw metric record off the wire (metrics-reporter types)."""
+
+    raw_type: RawMetricType
+    time_ms: float
+    broker_id: int
+    topic: Optional[str] = None
+    partition: Optional[int] = None
+    value: float = 0.0
+
+
+@dataclass
+class PartitionMetricSample:
+    """Per-partition model sample (PartitionMetricSample.java)."""
+
+    broker_id: int
+    topic: str
+    partition: int
+    time_ms: float = 0.0
+    metrics: np.ndarray = field(
+        default_factory=lambda: np.zeros(md.COMMON_METRIC_DEF.size))
+
+    @property
+    def entity(self) -> Tuple[str, int]:
+        return (self.topic, self.partition)
+
+    def record(self, metric_id: int, value: float) -> None:
+        self.metrics[metric_id] = value
+
+    def close(self, time_ms: float) -> None:
+        self.time_ms = time_ms
+
+    def to_dict(self) -> Dict:
+        return {
+            "brokerId": self.broker_id, "topic": self.topic,
+            "partition": self.partition, "time": self.time_ms,
+            "metrics": self.metrics.tolist(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "PartitionMetricSample":
+        s = cls(broker_id=d["brokerId"], topic=d["topic"], partition=d["partition"],
+                time_ms=d["time"])
+        s.metrics = np.asarray(d["metrics"], dtype=np.float64)
+        return s
+
+
+@dataclass
+class BrokerMetricSample:
+    """Per-broker model sample (BrokerMetricSample.java)."""
+
+    broker_id: int
+    time_ms: float = 0.0
+    metrics: np.ndarray = field(
+        default_factory=lambda: np.zeros(md.BROKER_METRIC_DEF.size))
+
+    @property
+    def entity(self) -> int:
+        return self.broker_id
+
+    def record(self, metric_id: int, value: float) -> None:
+        self.metrics[metric_id] = value
+
+    def to_dict(self) -> Dict:
+        return {"brokerId": self.broker_id, "time": self.time_ms,
+                "metrics": self.metrics.tolist()}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "BrokerMetricSample":
+        s = cls(broker_id=d["brokerId"], time_ms=d["time"])
+        s.metrics = np.asarray(d["metrics"], dtype=np.float64)
+        return s

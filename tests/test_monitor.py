@@ -1,0 +1,240 @@
+"""Monitor-layer tests.
+
+Models the reference's core aggregator tests (``RawMetricValuesTest``,
+``MetricSampleAggregatorTest`` with fake entities) and the mocked
+``LoadMonitorTest`` — no external cluster, a FakeMetadataBackend plays the
+embedded-broker role.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.exceptions import NotEnoughValidWindowsError
+from cruise_control_tpu.monitor import metric_def as md
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    MetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    MetadataClient,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
+from cruise_control_tpu.monitor.samples import PartitionMetricSample
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner, RunnerState
+
+W = 1000  # small window for tests
+
+
+def _agg(**kw):
+    defaults = dict(num_windows=5, window_ms=W, min_samples_per_window=2)
+    defaults.update(kw)
+    return MetricSampleAggregator(md.COMMON_METRIC_DEF, **defaults)
+
+
+def _metrics(cpu=1.0, nw_in=10.0, nw_out=5.0, disk=100.0):
+    m = np.zeros(md.COMMON_METRIC_DEF.size)
+    m[md.CPU_USAGE] = cpu
+    m[md.LEADER_BYTES_IN] = nw_in
+    m[md.LEADER_BYTES_OUT] = nw_out
+    m[md.DISK_USAGE] = disk
+    return m
+
+
+def fill(agg, entity, windows, per_window=2, cpu=1.0, disk=100.0):
+    for w in windows:
+        for i in range(per_window):
+            agg.add_sample(entity, w * W + 10 * (i + 1), _metrics(cpu=cpu, disk=disk))
+
+
+def test_avg_and_latest_strategies():
+    agg = _agg()
+    e = ("t", 0)
+    agg.add_sample(e, 100, _metrics(cpu=1.0, disk=50.0))
+    agg.add_sample(e, 200, _metrics(cpu=3.0, disk=70.0))
+    fill(agg, e, [1, 2, 3, 4, 5])  # later windows so window 0 completes
+    res = agg.aggregate(0, 6 * W)
+    vae = res.values_and_extrapolations[e]
+    w0 = vae.windows.index(0)
+    # CPU is AVG: (1+3)/2; DISK is LATEST: the t=200 sample wins.
+    assert vae.values[md.CPU_USAGE, w0] == pytest.approx(2.0)
+    assert vae.values[md.DISK_USAGE, w0] == pytest.approx(70.0)
+
+
+def test_avg_available_extrapolation():
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [0, 1, 2, 3], per_window=2)
+    agg.add_sample(e, 4 * W + 10, _metrics())      # 1 < min_samples: AVG_AVAILABLE
+    fill(agg, e, [5], per_window=1)                # active window (excluded)
+    res = agg.aggregate(0, 6 * W)
+    vae = res.values_and_extrapolations[e]
+    w4 = vae.windows.index(4)
+    assert vae.extrapolations[w4] is Extrapolation.AVG_AVAILABLE
+
+
+def test_avg_adjacent_extrapolation():
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [0, 1, 3, 4])                     # window 2 empty
+    fill(agg, e, [5], per_window=1)                # active
+    res = agg.aggregate(0, 6 * W)
+    vae = res.values_and_extrapolations[e]
+    w2 = vae.windows.index(2)
+    assert vae.extrapolations[w2] is Extrapolation.AVG_ADJACENT
+    assert vae.values[md.CPU_USAGE, w2] == pytest.approx(1.0)
+
+
+def test_forecast_extrapolation_trailing_gap():
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [0, 1, 2], cpu=2.0)
+    # Windows 3,4 empty; 5 active.
+    fill(agg, ("other", 1), [5], per_window=1)
+    res = agg.aggregate(0, 6 * W)
+    vae = res.values_and_extrapolations[e]
+    w4 = vae.windows.index(4)
+    assert vae.extrapolations[w4] in (Extrapolation.FORECAST,
+                                      Extrapolation.AVG_ADJACENT)
+    assert vae.values[md.CPU_USAGE, w4] == pytest.approx(2.0)
+
+
+def test_entity_invalid_when_leading_windows_empty():
+    agg = _agg()
+    good, bad = ("t", 0), ("t", 1)
+    fill(agg, good, [0, 1, 2, 3, 4])
+    fill(agg, bad, [3, 4])                         # windows 0-2 have no history
+    fill(agg, good, [5], per_window=1)             # active
+    res = agg.aggregate(0, 6 * W)
+    assert good in res.values_and_extrapolations
+    assert bad not in res.values_and_extrapolations
+    assert res.completeness.valid_entity_ratio == pytest.approx(0.5)
+
+
+def test_completeness_gate_raises():
+    agg = _agg()
+    fill(agg, ("t", 0), [3, 4])
+    fill(agg, ("t", 1), [0, 1, 2, 3, 4])
+    fill(agg, ("t", 1), [5], per_window=1)
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(0, 6 * W, AggregationOptions(min_valid_entity_ratio=0.9))
+
+
+def test_window_rollout_drops_old_samples():
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [0])
+    fill(agg, e, [10])                             # jump rolls the ring
+    assert agg.add_sample(e, 50, _metrics()) is False  # window 0 long gone
+    assert agg.num_available_windows() == 5
+
+
+def test_retain_entities():
+    agg = _agg()
+    fill(agg, ("t", 0), [0, 1])
+    fill(agg, ("t", 1), [0, 1])
+    agg.retain_entities({("t", 0)})
+    assert agg.all_entities() == [("t", 0)]
+
+
+# ------------------------------------------------------------- load monitor
+
+
+def _fake_cluster(num_brokers=3, partitions_per_topic=4, rf=2):
+    brokers = [BrokerInfo(i, rack=str(i % 2), host=f"h{i}") for i in range(num_brokers)]
+    parts = []
+    for t in ("A", "B"):
+        for p in range(partitions_per_topic):
+            reps = tuple((p + i) % num_brokers for i in range(rf))
+            parts.append(PartitionInfo(topic=t, partition=p, leader=reps[0],
+                                       replicas=reps, in_sync=reps))
+    return FakeMetadataBackend(brokers, parts)
+
+
+def _monitored(backend, windows=5):
+    client = MetadataClient(backend, ttl_ms=0)
+    lm = LoadMonitor(client, num_windows=windows, window_ms=W,
+                     min_samples_per_window=1)
+    sampler = SyntheticWorkloadSampler()
+    runner = LoadMonitorTaskRunner(lm, sampler, sampling_interval_ms=W)
+    return lm, runner
+
+
+def test_load_monitor_end_to_end():
+    backend = _fake_cluster()
+    lm, runner = _monitored(backend)
+    # Feed 6 windows of synthetic samples directly (bootstrap path).
+    runner.bootstrap(0, 6 * W)
+    assert lm.meet_completeness_requirements(
+        ModelCompletenessRequirements(min_required_num_windows=3,
+                                      min_monitored_partitions_percentage=0.9))
+    state, placement, meta = lm.cluster_model(0, 6 * W)
+    assert meta.num_brokers == 3
+    assert meta.num_replicas == 16           # 8 partitions × rf 2
+    # Leader loads populated: cluster-wide CPU > 0.
+    from cruise_control_tpu.model import ops
+    bl = np.asarray(ops.broker_load(state, placement))
+    assert bl[:, 0].sum() > 0
+    assert bl[:, 3].sum() > 0
+
+
+def test_load_monitor_feeds_optimizer():
+    backend = _fake_cluster()
+    lm, runner = _monitored(backend)
+    runner.bootstrap(0, 6 * W)
+    backend.kill_broker(2)
+    state, placement, meta = lm.cluster_model(0, 6 * W, pad_replicas_to=64,
+                                              pad_brokers_to=8)
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    res = GoalOptimizer(goal_names=["ReplicaCapacityGoal"]).optimizations(
+        state, placement, meta)
+    # All replicas of the dead broker get relocation proposals.
+    assert len(res.proposals) > 0
+    alive = np.asarray(state.alive)
+    final = np.asarray(res.final_placement.broker)[:meta.num_replicas]
+    assert alive[final].all()
+
+
+def test_sample_store_roundtrip(tmp_path):
+    store = FileSampleStore(str(tmp_path))
+    s = PartitionMetricSample(broker_id=1, topic="t", partition=0, time_ms=123.0)
+    s.record(md.CPU_USAGE, 0.5)
+    store.store_samples([s], [])
+    got = []
+    store.load_samples(lambda x: got.append(x), lambda x: None)
+    assert len(got) == 1
+    assert got[0].topic == "t"
+    assert got[0].metrics[md.CPU_USAGE] == pytest.approx(0.5)
+
+
+def test_task_runner_states_and_pause():
+    backend = _fake_cluster()
+    lm, runner = _monitored(backend)
+    assert runner.state is RunnerState.NOT_STARTED
+    runner.start()
+    assert runner.state is RunnerState.RUNNING
+    runner.pause_sampling("test")
+    assert runner.state is RunnerState.PAUSED
+    assert runner.run_sampling_once() == 0       # paused: no ingest
+    runner.resume_sampling()
+    assert runner.run_sampling_once() > 0
+    runner.shutdown()
+
+
+def test_metadata_generation_tracks_changes():
+    backend = _fake_cluster()
+    client = MetadataClient(backend, ttl_ms=0)
+    g0 = client.refresh_metadata().generation
+    client.refresh_metadata()
+    assert client.generation == g0               # unchanged topology
+    backend.kill_broker(1)
+    client.refresh_metadata()
+    assert client.generation == g0 + 1
