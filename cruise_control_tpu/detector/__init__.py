@@ -1,0 +1,38 @@
+"""Anomaly detection and self-healing.
+
+Reference: ``detector/AnomalyDetectorManager.java`` + the six detectors and
+the notifier SPI (``detector/notifier/*``).  Detection consumes the same
+frozen snapshots the analyzer uses; fixes route through the façade's normal
+propose→execute path exactly as the reference's self-healing does
+(SURVEY.md §3.5).
+"""
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    MetricAnomaly,
+    TopicAnomaly,
+)
+from cruise_control_tpu.detector.notifier import (
+    AnomalyNotificationResult,
+    NoopNotifier,
+    SelfHealingNotifier,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+
+__all__ = [
+    "Anomaly",
+    "AnomalyType",
+    "GoalViolations",
+    "BrokerFailures",
+    "DiskFailures",
+    "MetricAnomaly",
+    "TopicAnomaly",
+    "AnomalyNotificationResult",
+    "SelfHealingNotifier",
+    "NoopNotifier",
+    "AnomalyDetectorManager",
+]

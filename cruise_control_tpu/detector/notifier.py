@@ -1,0 +1,114 @@
+"""Anomaly notification / self-healing policy.
+
+Reference: ``detector/notifier/AnomalyNotifier.java`` SPI,
+``SelfHealingNotifier.java:57-148`` (broker-failure alert after 15 min,
+auto-fix after 30 min; per-type self-healing enable flags),
+``NoopNotifier``, ``SlackSelfHealingNotifier`` (webhook alerting — here a
+pluggable alert callback, since outbound webhooks are deployment glue).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType, BrokerFailures
+
+LOG = logging.getLogger(__name__)
+
+BROKER_FAILURE_ALERT_THRESHOLD_MS = 15 * 60 * 1000   # SelfHealingNotifier.java:67
+BROKER_FAILURE_SELF_HEALING_THRESHOLD_MS = 30 * 60 * 1000  # :68
+
+
+class AnomalyNotificationResult(enum.Enum):
+    FIX = "fix"
+    CHECK = "check"      # re-evaluate after delay_ms
+    IGNORE = "ignore"
+
+
+@dataclass
+class NotificationAction:
+    result: AnomalyNotificationResult
+    delay_ms: float = 0.0
+
+
+class NoopNotifier:
+    def on_anomaly(self, anomaly: Anomaly) -> NotificationAction:
+        return NotificationAction(AnomalyNotificationResult.IGNORE)
+
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return {t: False for t in AnomalyType}
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType, enabled: bool) -> bool:
+        return False
+
+
+class SelfHealingNotifier:
+    """Threshold-based self-healing policy (SelfHealingNotifier.java)."""
+
+    def __init__(
+        self,
+        self_healing_enabled: bool = False,
+        alert_callback: Optional[Callable[[Anomaly, bool], None]] = None,
+        clock=lambda: time.time() * 1000,
+        broker_failure_alert_threshold_ms: float = BROKER_FAILURE_ALERT_THRESHOLD_MS,
+        broker_failure_self_healing_threshold_ms: float =
+            BROKER_FAILURE_SELF_HEALING_THRESHOLD_MS,
+    ):
+        self._enabled: Dict[AnomalyType, bool] = {
+            t: self_healing_enabled for t in AnomalyType}
+        self._alert = alert_callback or (lambda anomaly, auto_fix: None)
+        self._clock = clock
+        self.alert_threshold_ms = broker_failure_alert_threshold_ms
+        self.self_healing_threshold_ms = broker_failure_self_healing_threshold_ms
+        self._alerted: Dict[int, bool] = {}
+
+    # -------------------------------------------------------------- toggles
+
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return dict(self._enabled)
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType, enabled: bool) -> bool:
+        old = self._enabled.get(anomaly_type, False)
+        self._enabled[anomaly_type] = enabled
+        return old
+
+    # --------------------------------------------------------------- policy
+
+    def on_anomaly(self, anomaly: Anomaly) -> NotificationAction:
+        if isinstance(anomaly, BrokerFailures):
+            return self._on_broker_failure(anomaly)
+        if not self._enabled.get(anomaly.anomaly_type, False):
+            self._alert(anomaly, False)
+            return NotificationAction(AnomalyNotificationResult.IGNORE)
+        if not anomaly.fixable:
+            self._alert(anomaly, False)
+            return NotificationAction(AnomalyNotificationResult.IGNORE)
+        return NotificationAction(AnomalyNotificationResult.FIX)
+
+    def _on_broker_failure(self, anomaly: BrokerFailures) -> NotificationAction:
+        """Grace-period logic (SelfHealingNotifier.java:106-148): alert after
+        the alert threshold, auto-fix only after the self-healing threshold
+        (measured from the EARLIEST broker failure)."""
+        now = self._clock()
+        if not anomaly.failed_brokers:
+            return NotificationAction(AnomalyNotificationResult.IGNORE)
+        earliest = min(anomaly.failed_brokers.values())
+        alert_time = earliest + self.alert_threshold_ms
+        fix_time = earliest + self.self_healing_threshold_ms
+        if now < alert_time:
+            return NotificationAction(AnomalyNotificationResult.CHECK,
+                                      delay_ms=alert_time - now)
+        auto_fix = self._enabled.get(AnomalyType.BROKER_FAILURE, False)
+        if not self._alerted.get(anomaly.anomaly_id):
+            self._alerted[anomaly.anomaly_id] = True
+            self._alert(anomaly, auto_fix and now >= fix_time)
+        if not auto_fix:
+            return NotificationAction(AnomalyNotificationResult.IGNORE)
+        if now < fix_time:
+            return NotificationAction(AnomalyNotificationResult.CHECK,
+                                      delay_ms=fix_time - now)
+        return NotificationAction(AnomalyNotificationResult.FIX)

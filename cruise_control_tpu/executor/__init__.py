@@ -1,0 +1,22 @@
+"""Executor: applies optimization proposals to the live cluster.
+
+Reference: ``executor/Executor.java:73-1545`` and its task-management
+satellites.  All host-side control logic (no TPU involvement — this layer
+throttles the managed cluster, not compute); the cluster-facing operations go
+through a pluggable admin backend (fake in tests, a Kafka driver in
+deployments) the way the reference splits Executor from
+ExecutorUtils.scala/ExecutorAdminUtils.
+"""
+
+from cruise_control_tpu.executor.tasks import ExecutionTask, ExecutionTaskState, ExecutionTaskTracker
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.executor import Executor, ExecutorState
+
+__all__ = [
+    "ExecutionTask",
+    "ExecutionTaskState",
+    "ExecutionTaskTracker",
+    "ExecutionTaskPlanner",
+    "Executor",
+    "ExecutorState",
+]

@@ -1,0 +1,317 @@
+"""The executor state machine.
+
+Reference: ``executor/Executor.java:73-1545`` — states NO_TASK →
+STARTING_EXECUTION → INTER_BROKER_REPLICA_MOVEMENT → INTRA_BROKER_REPLICA_
+MOVEMENT → LEADER_MOVEMENT → STOPPING; batched movements under per-broker
+caps with progress polling (:1163-1330), task-dead/abort handling
+(:1457-1540), user-triggered stop (:782), AIMD concurrency auto-tuning
+(ConcurrencyAdjuster :313-375), and replication throttling around an
+execution (ReplicationThrottleHelper.java:29-321).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.common.actions import ExecutionProposal
+from cruise_control_tpu.common.exceptions import OngoingExecutionError
+from cruise_control_tpu.executor.backend import ClusterAdminBackend
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategies import AbstractReplicaMovementStrategy
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskState,
+    ExecutionTaskTracker,
+    TaskType,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class ExecutorState(enum.Enum):
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "INTER_BROKER_REPLICA_MOVEMENT"
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "INTRA_BROKER_REPLICA_MOVEMENT"
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+@dataclass
+class ConcurrencyAdjuster:
+    """AIMD per-broker concurrency tuning (Executor.java:313-375): additive
+    increase while the cluster looks healthy, multiplicative decrease on
+    distress signals."""
+
+    min_concurrency: int = 1
+    max_concurrency: int = 12
+    current: int = 5
+    increase_step: int = 1
+    decrease_factor: float = 2.0
+
+    def on_healthy(self) -> int:
+        self.current = min(self.max_concurrency, self.current + self.increase_step)
+        return self.current
+
+    def on_distress(self) -> int:
+        self.current = max(self.min_concurrency,
+                           int(self.current / self.decrease_factor))
+        return self.current
+
+
+@dataclass
+class ExecutorConfig:
+    concurrent_partition_movements_per_broker: int = 5
+    concurrent_intra_broker_partition_movements: int = 2
+    concurrent_leader_movements: int = 1000
+    max_num_cluster_movements: int = 1250
+    progress_check_interval_s: float = 0.01
+    replication_throttle_bytes_per_s: Optional[int] = None
+    task_execution_alert_timeout_s: float = 90.0
+    auto_adjust_concurrency: bool = False
+
+
+class Executor:
+    """Applies proposal batches via the admin backend."""
+
+    def __init__(self, backend: ClusterAdminBackend,
+                 config: Optional[ExecutorConfig] = None,
+                 strategy: Optional[AbstractReplicaMovementStrategy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.backend = backend
+        self.config = config or ExecutorConfig()
+        self._strategy = strategy
+        self._clock = clock
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._lock = threading.RLock()
+        self._stop_requested = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tracker = ExecutionTaskTracker()
+        self._planner: Optional[ExecutionTaskPlanner] = None
+        self.adjuster = ConcurrencyAdjuster(
+            max_concurrency=self.config.concurrent_partition_movements_per_broker * 2,
+            current=self.config.concurrent_partition_movements_per_broker)
+        self._on_finish: List[Callable[[], None]] = []
+        self._pause_sampling: Optional[Callable[[], None]] = None
+        self._resume_sampling: Optional[Callable[[], None]] = None
+        self._generating_proposals_for_execution = False
+
+    # ------------------------------------------------------------- wiring
+
+    def set_sampling_hooks(self, pause: Callable[[], None],
+                           resume: Callable[[], None]) -> None:
+        """LoadMonitor pause/resume around executions (Executor :959-975)."""
+        self._pause_sampling = pause
+        self._resume_sampling = resume
+
+    def add_finish_listener(self, fn: Callable[[], None]) -> None:
+        self._on_finish.append(fn)
+
+    # -------------------------------------------------------------- state
+
+    @property
+    def state(self) -> ExecutorState:
+        with self._lock:
+            return self._state
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self.state is not ExecutorState.NO_TASK_IN_PROGRESS
+
+    def set_generating_proposals_for_execution(self, flag: bool = True) -> None:
+        """Reference Executor.setGeneratingProposalsForExecution :737 — blocks
+        competing executions while proposals are being computed."""
+        with self._lock:
+            if flag and (self.has_ongoing_execution
+                         or self._generating_proposals_for_execution):
+                raise OngoingExecutionError("an execution is already in progress")
+            self._generating_proposals_for_execution = flag
+
+    def state_summary(self) -> Dict:
+        return {
+            "state": self.state.value,
+            "tasks": self.tracker.summary(),
+            "finishedDataMovementMB": round(self.tracker.finished_data_movement_mb, 3),
+            "concurrency": self.adjuster.current,
+        }
+
+    # ------------------------------------------------------------ execute
+
+    def execute_proposals(self, proposals: Sequence[ExecutionProposal],
+                          wait: bool = True) -> None:
+        """Start executing proposals (Executor.executeProposals :500)."""
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("an execution is already in progress")
+            external = self.backend.in_progress_reassignments()
+            if external:
+                raise OngoingExecutionError(
+                    f"{len(external)} reassignments already in progress "
+                    "(externally initiated?)")
+            self._generating_proposals_for_execution = False
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested.clear()
+            self._planner = ExecutionTaskPlanner(self._strategy)
+            total = min(len(proposals), self.config.max_num_cluster_movements)
+            for t in self._planner.add_proposals(list(proposals)[:total]):
+                self.tracker.add(t)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="proposal-execution")
+        self._thread.start()
+        if wait:
+            self._thread.join()
+
+    def user_triggered_stop_execution(self) -> None:
+        """Executor.userTriggeredStopExecution :782."""
+        with self._lock:
+            if self.has_ongoing_execution:
+                self._state = ExecutorState.STOPPING_EXECUTION
+                self._stop_requested.set()
+
+    # ---------------------------------------------------------- internals
+
+    def _set_state(self, s: ExecutorState) -> None:
+        with self._lock:
+            if self._state is not ExecutorState.STOPPING_EXECUTION:
+                self._state = s
+
+    def _run(self) -> None:
+        try:
+            if self._pause_sampling:
+                self._pause_sampling()
+            throttled = [
+                (t.proposal.topic_partition.topic, t.proposal.topic_partition.partition)
+                for t in self._planner.remaining_inter_broker_tasks]
+            if self.config.replication_throttle_bytes_per_s and throttled:
+                self.backend.set_throttles(
+                    self.config.replication_throttle_bytes_per_s, throttled)
+            self._set_state(
+                ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+            self._move_replicas(TaskType.INTER_BROKER_REPLICA_ACTION,
+                                self._planner.inter_broker_tasks,
+                                self.backend.execute_replica_reassignments,
+                                self.config.concurrent_partition_movements_per_broker)
+            self._set_state(
+                ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+            self._move_replicas(TaskType.INTRA_BROKER_REPLICA_ACTION,
+                                self._planner.intra_broker_tasks,
+                                self.backend.execute_logdir_moves,
+                                self.config.concurrent_intra_broker_partition_movements)
+            self._set_state(ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS)
+            self._move_leaderships()
+        finally:
+            if self._stop_requested.is_set() and self._planner is not None:
+                for t in self._planner.clear():
+                    if t.state is ExecutionTaskState.PENDING:
+                        self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
+                                                self._now_ms())
+                        self.tracker.transition(t, ExecutionTaskState.DEAD,
+                                                self._now_ms())
+            if self.config.replication_throttle_bytes_per_s:
+                self.backend.clear_throttles()
+            if self._resume_sampling:
+                self._resume_sampling()
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            for fn in self._on_finish:
+                try:
+                    fn()
+                except Exception:       # noqa: BLE001 — listeners must not kill us
+                    LOG.exception("execution finish listener failed")
+
+    def _now_ms(self) -> float:
+        return self._clock() * 1000.0
+
+    def _concurrency(self) -> int:
+        return (self.adjuster.current if self.config.auto_adjust_concurrency
+                else self.config.concurrent_partition_movements_per_broker)
+
+    def _move_replicas(self, task_type: TaskType, batch_fn, submit_fn,
+                       per_broker_cap: int) -> None:
+        """Batched movement loop (interBrokerMoveReplicas :1163-1225)."""
+        in_flight: Dict[int, int] = {}
+        active: List[ExecutionTask] = []
+        while not self._stop_requested.is_set():
+            cap = (self._concurrency()
+                   if task_type is TaskType.INTER_BROKER_REPLICA_ACTION
+                   else per_broker_cap)
+            ready = {b: cap for t in self._all_brokers(task_type) for b in [t]}
+            batch = batch_fn(ready, in_flight)
+            if batch:
+                submit_fn(batch)
+                for t in batch:
+                    self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
+                                            self._now_ms())
+                    for b in t.brokers_involved:
+                        in_flight[b] = in_flight.get(b, 0) + 1
+                active.extend(batch)
+            if not active:
+                if not batch and self._planner_queue_empty(task_type):
+                    break
+                continue
+            time.sleep(self.config.progress_check_interval_s)
+            still_active: List[ExecutionTask] = []
+            for t in active:
+                if self.backend.finished(t):
+                    self.tracker.transition(t, ExecutionTaskState.COMPLETED,
+                                            self._now_ms())
+                    for b in t.brokers_involved:
+                        in_flight[b] = max(in_flight.get(b, 0) - 1, 0)
+                elif (self._now_ms() - t.start_time_ms
+                      > self.config.task_execution_alert_timeout_s * 1000):
+                    self.tracker.transition(t, ExecutionTaskState.DEAD,
+                                            self._now_ms())
+                    for b in t.brokers_involved:
+                        in_flight[b] = max(in_flight.get(b, 0) - 1, 0)
+                    if self.config.auto_adjust_concurrency:
+                        self.adjuster.on_distress()
+                else:
+                    still_active.append(t)
+            if self.config.auto_adjust_concurrency and not still_active:
+                self.adjuster.on_healthy()
+            active = still_active
+        # Stop requested: abort whatever is in flight.
+        for t in active:
+            self.tracker.transition(t, ExecutionTaskState.ABORTING, self._now_ms())
+            self.tracker.transition(t, ExecutionTaskState.ABORTED, self._now_ms())
+
+    def _planner_queue_empty(self, task_type: TaskType) -> bool:
+        if task_type is TaskType.INTER_BROKER_REPLICA_ACTION:
+            return not self._planner.remaining_inter_broker_tasks
+        return not self._planner.remaining_intra_broker_tasks
+
+    def _all_brokers(self, task_type: TaskType) -> Set[int]:
+        tasks = (self._planner.remaining_inter_broker_tasks
+                 if task_type is TaskType.INTER_BROKER_REPLICA_ACTION
+                 else self._planner.remaining_intra_broker_tasks)
+        out: Set[int] = set()
+        for t in tasks:
+            out.update(t.brokers_involved)
+        return out
+
+    def _move_leaderships(self) -> None:
+        """Leadership batches (moveLeaderships :1281-1330)."""
+        while not self._stop_requested.is_set():
+            batch = self._planner.leadership_tasks(
+                self.config.concurrent_leader_movements)
+            if not batch:
+                break
+            self.backend.execute_preferred_leader_election(batch)
+            for t in batch:
+                self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
+                                        self._now_ms())
+            pending = list(batch)
+            while pending and not self._stop_requested.is_set():
+                time.sleep(self.config.progress_check_interval_s)
+                pending = [t for t in pending if not self._maybe_complete(t)]
+
+    def _maybe_complete(self, t: ExecutionTask) -> bool:
+        if self.backend.finished(t):
+            self.tracker.transition(t, ExecutionTaskState.COMPLETED, self._now_ms())
+            return True
+        return False

@@ -1,0 +1,370 @@
+"""Orchestration façade.
+
+Reference: ``KafkaCruiseControl.java:73-856`` — the single object wiring
+LoadMonitor + GoalOptimizer + Executor + AnomalyDetectorManager and exposing
+every operation the API layer serves: cluster model queries, proposals,
+rebalance, add/remove/demote brokers, fix offline replicas, topic RF change,
+pause/resume sampling, self-healing toggles, stop execution.  Operations
+follow the GoalBasedOperationRunnable template
+(``servlet/handler/async/runnable/GoalBasedOperationRunnable.java:100-211``):
+sanity checks → reserve execution → compute on a fresh snapshot → optionally
+execute.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.analyzer import (
+    BalancingConstraint,
+    GoalOptimizer,
+    OptimizationOptions,
+    OptimizerResult,
+)
+from cruise_control_tpu.analyzer.goals.registry import DEFAULT_GOALS
+from cruise_control_tpu.common.exceptions import OngoingExecutionError, UserRequestError
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    MaintenanceEvent,
+    MetricAnomaly,
+    TopicAnomaly,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    MaintenanceEventDetector,
+    MetricAnomalyDetector,
+    TopicAnomalyDetector,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import NoopNotifier, SelfHealingNotifier
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.model.stats import compute_stats
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+
+LOG = logging.getLogger(__name__)
+
+PAD_R, PAD_B = 64, 8   # snapshot padding size-class floors
+
+
+@dataclass
+class OperationResult:
+    """What every operation returns to the API layer."""
+
+    optimizer_result: Optional[OptimizerResult]
+    dryrun: bool
+    executed: bool
+    info: str = ""
+
+    def to_dict(self) -> Dict:
+        d = {"dryrun": self.dryrun, "executed": self.executed, "info": self.info}
+        if self.optimizer_result is not None:
+            d["result"] = self.optimizer_result.to_dict()
+        return d
+
+
+class CruiseControl:
+    """The façade. All cross-component calls route through here."""
+
+    def __init__(
+        self,
+        load_monitor: LoadMonitor,
+        executor: Executor,
+        task_runner: Optional[LoadMonitorTaskRunner] = None,
+        constraint: Optional[BalancingConstraint] = None,
+        default_goals: Optional[Sequence[str]] = None,
+        notifier=None,
+        self_healing_goals: Optional[Sequence[str]] = None,
+        anomaly_detection_interval_s: float = 300.0,
+    ):
+        self.load_monitor = load_monitor
+        self.executor = executor
+        self.task_runner = task_runner
+        self.constraint = constraint or BalancingConstraint()
+        self.default_goals = list(default_goals or DEFAULT_GOALS)
+        self.optimizer = GoalOptimizer(constraint=self.constraint,
+                                       goal_names=self.default_goals)
+        self.notifier = notifier or SelfHealingNotifier()
+        self._lock = threading.RLock()
+        if task_runner is not None:
+            executor.set_sampling_hooks(
+                lambda: task_runner.pause_sampling("executor"),
+                lambda: task_runner.resume_sampling("executor"))
+        self.anomaly_detector = self._build_anomaly_detector(
+            self_healing_goals, anomaly_detection_interval_s)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start_up(self) -> None:
+        """KafkaCruiseControl.startUp :201-232."""
+        if self.task_runner is not None:
+            self.task_runner.start()
+        self.anomaly_detector.start_detection()
+
+    def shutdown(self) -> None:
+        self.anomaly_detector.shutdown()
+        if self.task_runner is not None:
+            self.task_runner.shutdown()
+
+    def _build_anomaly_detector(self, self_healing_goals,
+                                interval_s) -> AnomalyDetectorManager:
+        detectors = {
+            AnomalyType.GOAL_VIOLATION: GoalViolationDetector(
+                self.load_monitor, goal_names=self_healing_goals),
+            AnomalyType.BROKER_FAILURE: BrokerFailureDetector(
+                self.load_monitor.metadata_client),
+            AnomalyType.DISK_FAILURE: DiskFailureDetector(lambda: {}),
+            AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(
+                self.load_monitor.broker_aggregator),
+            AnomalyType.TOPIC_ANOMALY: TopicAnomalyDetector(
+                self.load_monitor.metadata_client),
+            AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(),
+        }
+        return AnomalyDetectorManager(
+            detectors, notifier=self.notifier, fixer=self._fix_anomaly,
+            detection_interval_s=interval_s)
+
+    # ---------------------------------------------------------- model views
+
+    def cluster_model_snapshot(self, allow_capacity_estimation: bool = True):
+        return self.load_monitor.cluster_model(
+            allow_capacity_estimation=allow_capacity_estimation,
+            pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+
+    def broker_stats(self) -> Dict:
+        """GET /load (KafkaCruiseControl.clusterModel + brokerStats)."""
+        state, placement, meta = self.cluster_model_snapshot()
+        stats = compute_stats(state, placement, self.constraint.balance_threshold)
+        return stats.to_dict()
+
+    def partition_load(self, max_entries: int = 100) -> List[Dict]:
+        """GET /partition_load: per-partition loads sorted by utilization."""
+        import numpy as np
+
+        from cruise_control_tpu.model import ops
+        state, placement, meta = self.cluster_model_snapshot()
+        load = np.asarray(ops.effective_load(state, placement))[:meta.num_replicas]
+        lead = np.asarray(placement.is_leader)[:meta.num_replicas]
+        part = np.asarray(state.partition)[:meta.num_replicas]
+        out = []
+        leaders = np.nonzero(lead)[0]
+        order = leaders[np.argsort(-load[leaders].sum(axis=1))]
+        for r in order[:max_entries]:
+            t_idx, p_num = meta.partitions[part[r]]
+            out.append({
+                "topic": meta.topics[t_idx], "partition": int(p_num),
+                "cpu": float(load[r][0]), "networkInbound": float(load[r][1]),
+                "networkOutbound": float(load[r][2]), "disk": float(load[r][3]),
+            })
+        return out
+
+    # ------------------------------------------------------------ operations
+
+    def _run_operation(
+        self,
+        goals: Optional[Sequence[str]],
+        options: OptimizationOptions,
+        dryrun: bool,
+        model_mutator=None,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        use_cached: bool = False,
+    ) -> OperationResult:
+        goals = list(goals or self.default_goals)
+        if not dryrun:
+            self.executor.set_generating_proposals_for_execution(True)
+        try:
+            builder = self.load_monitor.cluster_model_builder(
+                requirements=requirements)
+            if model_mutator is not None:
+                model_mutator(builder)
+            state, placement, meta = builder.freeze(pad_replicas_to=PAD_R,
+                                                    pad_brokers_to=PAD_B)
+            optimizer = (self.optimizer if goals == self.default_goals
+                         else GoalOptimizer(constraint=self.constraint,
+                                            goal_names=goals))
+            generation = (self.load_monitor.model_generation
+                          if use_cached and model_mutator is None else None)
+            result = optimizer.optimizations(
+                state, placement, meta, options=options,
+                model_generation=generation)
+            executed = False
+            if not dryrun and result.proposals:
+                self.executor.execute_proposals(result.proposals, wait=False)
+                executed = True
+            elif not dryrun:
+                self.executor.set_generating_proposals_for_execution(False)
+            return OperationResult(result, dryrun=dryrun, executed=executed)
+        except Exception:
+            if not dryrun:
+                try:
+                    self.executor.set_generating_proposals_for_execution(False)
+                except OngoingExecutionError:
+                    pass
+            raise
+
+    def proposals(self, goals: Optional[Sequence[str]] = None,
+                  options: Optional[OptimizationOptions] = None) -> OperationResult:
+        """GET /proposals — always dryrun, uses the proposal cache."""
+        return self._run_operation(goals, options or OptimizationOptions(),
+                                   dryrun=True, use_cached=True)
+
+    def rebalance(self, goals: Optional[Sequence[str]] = None,
+                  dryrun: bool = True,
+                  options: Optional[OptimizationOptions] = None) -> OperationResult:
+        """POST /rebalance (RebalanceRunnable)."""
+        return self._run_operation(goals, options or OptimizationOptions(),
+                                   dryrun=dryrun)
+
+    def add_brokers(self, broker_ids: Sequence[int],
+                    goals: Optional[Sequence[str]] = None,
+                    dryrun: bool = True) -> OperationResult:
+        """POST /add_broker (AddBrokersRunnable): mark brokers as new and let
+        distribution goals pull load onto them."""
+        ids = set(broker_ids)
+
+        def mutate(cm: ClusterModel):
+            for b in cm.brokers():
+                if b.broker_id in ids:
+                    b.new_broker = True
+
+        return self._run_operation(goals, OptimizationOptions(), dryrun,
+                                   model_mutator=mutate)
+
+    def remove_brokers(self, broker_ids: Sequence[int],
+                       goals: Optional[Sequence[str]] = None,
+                       dryrun: bool = True) -> OperationResult:
+        """POST /remove_broker (RemoveBrokersRunnable): decommission — mark
+        dead so every goal must evacuate them, and exclude them as
+        destinations."""
+        ids = set(broker_ids)
+
+        def mutate(cm: ClusterModel):
+            for b in ids:
+                cm.set_broker_state(b, alive=False)
+
+        options = OptimizationOptions(
+            excluded_brokers_for_replica_move=frozenset(ids),
+            excluded_brokers_for_leadership=frozenset(ids))
+        return self._run_operation(goals, options, dryrun, model_mutator=mutate)
+
+    def demote_brokers(self, broker_ids: Sequence[int],
+                       dryrun: bool = True) -> OperationResult:
+        """POST /demote_broker (DemoteBrokerRunnable): move leadership off
+        the brokers via preferred-leader election with them excluded."""
+        options = OptimizationOptions(
+            excluded_brokers_for_leadership=frozenset(broker_ids))
+        return self._run_operation(["PreferredLeaderElectionGoal"], options, dryrun)
+
+    def fix_offline_replicas(self, goals: Optional[Sequence[str]] = None,
+                             dryrun: bool = True) -> OperationResult:
+        """POST /fix_offline_replicas (FixOfflineReplicasRunnable)."""
+        return self._run_operation(goals, OptimizationOptions(), dryrun)
+
+    def change_topic_replication_factor(self, topic: str, target_rf: int,
+                                        goals: Optional[Sequence[str]] = None,
+                                        dryrun: bool = True) -> OperationResult:
+        """POST /topic_configuration (TopicConfigurationRunnable →
+        ClusterModel.createOrDeleteReplicas :962-1027)."""
+
+        def mutate(cm: ClusterModel):
+            cm.create_or_delete_replicas(topic, target_rf)
+
+        return self._run_operation(goals, OptimizationOptions(), dryrun,
+                                   model_mutator=mutate)
+
+    def stop_execution(self) -> None:
+        self.executor.user_triggered_stop_execution()
+
+    # ------------------------------------------------------- sampling admin
+
+    def pause_sampling(self, reason: str = "user requested") -> None:
+        if self.task_runner is None:
+            raise UserRequestError("no sampling task runner configured")
+        self.task_runner.pause_sampling(reason)
+
+    def resume_sampling(self, reason: str = "user requested") -> None:
+        if self.task_runner is None:
+            raise UserRequestError("no sampling task runner configured")
+        self.task_runner.resume_sampling(reason)
+
+    # ----------------------------------------------------------- self-healing
+
+    def set_self_healing(self, anomaly_type: AnomalyType, enabled: bool) -> bool:
+        return self.notifier.set_self_healing_for(anomaly_type, enabled)
+
+    def _fix_anomaly(self, anomaly: Anomaly) -> bool:
+        """Self-healing dispatch (§3.5): every fix is a normal operation."""
+        try:
+            if isinstance(anomaly, BrokerFailures):
+                r = self.remove_brokers(sorted(anomaly.failed_brokers), dryrun=False)
+            elif isinstance(anomaly, DiskFailures):
+                r = self.fix_offline_replicas(dryrun=False)
+            elif isinstance(anomaly, GoalViolations):
+                r = self.rebalance(anomaly.fixable_violated_goals or None,
+                                   dryrun=False)
+            elif isinstance(anomaly, MetricAnomaly):
+                if anomaly.suggested_action == "remove":
+                    r = self.remove_brokers([anomaly.broker_id], dryrun=False)
+                elif anomaly.suggested_action == "demote":
+                    r = self.demote_brokers([anomaly.broker_id], dryrun=False)
+                else:
+                    return False
+            elif isinstance(anomaly, TopicAnomaly):
+                if anomaly.target_replication_factor is None:
+                    return False
+                r = self.change_topic_replication_factor(
+                    anomaly.topic, anomaly.target_replication_factor, dryrun=False)
+            elif isinstance(anomaly, MaintenanceEvent):
+                r = self._run_maintenance(anomaly)
+            else:
+                return False
+            return r.executed or bool(r.optimizer_result
+                                      and not r.optimizer_result.proposals)
+        except OngoingExecutionError:
+            LOG.info("fix deferred: execution already in progress")
+            return False
+
+    def _run_maintenance(self, event: MaintenanceEvent) -> OperationResult:
+        if event.plan == "add_broker":
+            return self.add_brokers(event.broker_ids, dryrun=False)
+        if event.plan == "remove_broker":
+            return self.remove_brokers(event.broker_ids, dryrun=False)
+        if event.plan == "demote_broker":
+            return self.demote_brokers(event.broker_ids, dryrun=False)
+        if event.plan == "fix_offline_replicas":
+            return self.fix_offline_replicas(dryrun=False)
+        if event.plan == "topic_replication_factor":
+            return self.change_topic_replication_factor(
+                event.topic, event.replication_factor, dryrun=False)
+        return self.rebalance(dryrun=False)
+
+    # ---------------------------------------------------------------- state
+
+    def state(self) -> Dict:
+        """GET /state aggregation (CruiseControlState.java)."""
+        runner_state = (self.task_runner.state.value
+                        if self.task_runner is not None else "NOT_STARTED")
+        return {
+            "MonitorState": self.load_monitor.state(runner_state).to_dict(),
+            "ExecutorState": self.executor.state_summary(),
+            "AnomalyDetectorState": self.anomaly_detector.state_summary(),
+            "AnalyzerState": {
+                "isProposalReady": True,
+                "goalReadiness": [
+                    {"name": g, "status": "ready"} for g in self.default_goals],
+            },
+        }

@@ -1,0 +1,192 @@
+"""Detector + self-healing tests (models AnomalyDetectorManagerTest: mock
+detectors + the real queue/handler, and detector-specific scenarios)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    GoalViolations,
+    MaintenanceEvent,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    MaintenanceEventDetector,
+    MetricAnomalyDetector,
+    TopicAnomalyDetector,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import (
+    AnomalyNotificationResult,
+    SelfHealingNotifier,
+)
+from cruise_control_tpu.monitor import metric_def as md
+from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    MetadataClient,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+
+W = 1000
+
+
+def _cluster(num_brokers=4):
+    brokers = [BrokerInfo(i, rack=str(i % 2), host=f"h{i}") for i in range(num_brokers)]
+    parts = [PartitionInfo("T", p, leader=p % num_brokers,
+                           replicas=(p % num_brokers, (p + 1) % num_brokers),
+                           in_sync=(p % num_brokers,))
+             for p in range(8)]
+    return FakeMetadataBackend(brokers, parts)
+
+
+def _monitored(backend):
+    client = MetadataClient(backend, ttl_ms=0)
+    lm = LoadMonitor(client, num_windows=5, window_ms=W, min_samples_per_window=1)
+    runner = LoadMonitorTaskRunner(lm, SyntheticWorkloadSampler(),
+                                   sampling_interval_ms=W)
+    runner.bootstrap(0, 6 * W)
+    return lm
+
+
+def test_broker_failure_detector_tracks_and_persists(tmp_path):
+    backend = _cluster()
+    client = MetadataClient(backend, ttl_ms=0)
+    path = str(tmp_path / "failed.json")
+    clock = {"now": 1_000.0}
+    det = BrokerFailureDetector(client, persist_path=path,
+                                clock=lambda: clock["now"])
+    assert det.detect() == []
+    backend.kill_broker(2)
+    found = det.detect()
+    assert len(found) == 1 and isinstance(found[0], BrokerFailures)
+    assert found[0].failed_brokers == {2: 1_000.0}
+    # Restart: timestamps survive via the persisted record.
+    clock["now"] = 9_999.0
+    det2 = BrokerFailureDetector(client, persist_path=path,
+                                 clock=lambda: clock["now"])
+    assert det2.detect()[0].failed_brokers == {2: 1_000.0}
+
+
+def test_goal_violation_detector_flags_and_skips_same_generation():
+    backend = _cluster()
+    lm = _monitored(backend)
+    backend.kill_broker(3)
+    det = GoalViolationDetector(lm, goal_names=["ReplicaCapacityGoal"])
+    found = det.detect()
+    assert len(found) == 1
+    assert found[0].fixable_violated_goals == ["ReplicaCapacityGoal"]
+    # Same model generation → detector skips (reference :114-121).
+    assert det.detect() == []
+
+
+def test_disk_failure_detector():
+    det = DiskFailureDetector(lambda: {1: [0]})
+    found = det.detect()
+    assert found[0].failed_disks == {1: [0]}
+    det2 = DiskFailureDetector(lambda: {})
+    assert det2.detect() == []
+
+
+def test_metric_anomaly_detector_flags_slow_broker():
+    agg = MetricSampleAggregator(md.BROKER_METRIC_DEF, num_windows=5, window_ms=W,
+                                 min_samples_per_window=1)
+    flush = md.BROKER_METRIC_DEF.metric_id("BROKER_LOG_FLUSH_TIME_MS_MEAN")
+
+    def metrics(v):
+        m = np.zeros(md.BROKER_METRIC_DEF.size)
+        m[flush] = v
+        return m
+
+    for w in range(6):
+        for b in range(4):
+            slow = b == 3 and w == 4
+            agg.add_sample(b, w * W + 10, metrics(100.0 if slow else 1.0))
+    det = MetricAnomalyDetector(agg, percentile=90, margin=1.5,
+                                slow_broker_demotion_score=1)
+    found = det.detect()
+    assert any(a.broker_id == 3 for a in found)
+
+
+def test_topic_anomaly_detector_rf():
+    backend = _cluster()
+    client = MetadataClient(backend, ttl_ms=0)
+    det = TopicAnomalyDetector(client, target_replication_factor=3)
+    found = det.detect()
+    assert len(found) == 1 and found[0].topic == "T"
+    assert found[0].target_replication_factor == 3
+
+
+def test_maintenance_event_idempotence():
+    det = MaintenanceEventDetector(idempotence_ttl_ms=1e9)
+    e = MaintenanceEvent(plan="rebalance")
+    assert det.submit(e) is True
+    assert det.submit(MaintenanceEvent(plan="rebalance")) is False  # duplicate
+    assert det.submit(MaintenanceEvent(plan="remove_broker", broker_ids=(1,)))
+    found = det.detect()
+    assert len(found) == 2
+    assert det.detect() == []
+
+
+def test_self_healing_notifier_broker_failure_grace_periods():
+    clock = {"now": 0.0}
+    alerts = []
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=True,
+        alert_callback=lambda a, fix: alerts.append(fix),
+        clock=lambda: clock["now"],
+        broker_failure_alert_threshold_ms=100,
+        broker_failure_self_healing_threshold_ms=200,
+    )
+    a = BrokerFailures(failed_brokers={1: 0.0})
+    # Before alert threshold: delayed check.
+    act = notifier.on_anomaly(a)
+    assert act.result is AnomalyNotificationResult.CHECK
+    # Past alert, before fix: alert fired, still check.
+    clock["now"] = 150.0
+    act = notifier.on_anomaly(a)
+    assert act.result is AnomalyNotificationResult.CHECK
+    assert len(alerts) == 1
+    # Past the self-healing threshold: fix.
+    clock["now"] = 250.0
+    assert notifier.on_anomaly(a).result is AnomalyNotificationResult.FIX
+
+
+def test_manager_priority_and_fix_dispatch():
+    fixed = []
+
+    class StubDetector:
+        def __init__(self, anomaly):
+            self.anomaly = anomaly
+            self.fired = False
+
+        def detect(self):
+            if self.fired:
+                return []
+            self.fired = True
+            return [self.anomaly]
+
+    gv = GoalViolations(fixable=["ReplicaDistributionGoal"])
+    bf = BrokerFailures(failed_brokers={1: 0.0})
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=True, clock=lambda: 1e12,
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    mgr = AnomalyDetectorManager(
+        {AnomalyType.GOAL_VIOLATION: StubDetector(gv),
+         AnomalyType.BROKER_FAILURE: StubDetector(bf)},
+        notifier=notifier,
+        fixer=lambda a: fixed.append(a.anomaly_type) or True)
+    mgr.run_detection_once()
+    # Broker failure (priority 0) handled before goal violation (priority 3).
+    assert fixed == [AnomalyType.BROKER_FAILURE, AnomalyType.GOAL_VIOLATION]
+    summary = mgr.state_summary()
+    assert summary["metrics"]["FIX_STARTED"] == 2

@@ -1,0 +1,191 @@
+"""Executor tests.
+
+Models the reference's ``ExecutionTaskPlannerTest`` / ``ExecutionTaskManagerTest``
+and the embedded-broker ``ExecutorTest`` — the FakeClusterBackend +
+FakeMetadataBackend pair replaces the embedded ZK/brokers.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.actions import (
+    ExecutionProposal,
+    ReplicaPlacementInfo,
+    TopicPartition,
+)
+from cruise_control_tpu.common.exceptions import OngoingExecutionError
+from cruise_control_tpu.executor.backend import FakeClusterBackend
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig, ExecutorState
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategies import (
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+)
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskState,
+    TaskType,
+)
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    PartitionInfo,
+)
+
+
+def proposal(topic, part, old, new, size=100.0):
+    return ExecutionProposal(
+        topic_partition=TopicPartition(topic, part),
+        partition_size=size,
+        old_leader=ReplicaPlacementInfo(old[0]),
+        old_replicas=tuple(ReplicaPlacementInfo(b) for b in old),
+        new_replicas=tuple(ReplicaPlacementInfo(b) for b in new),
+    )
+
+
+def _metadata(num_brokers=4):
+    brokers = [BrokerInfo(i, rack=str(i % 2), host=f"h{i}") for i in range(num_brokers)]
+    parts = [PartitionInfo("T", p, leader=p % num_brokers,
+                           replicas=(p % num_brokers, (p + 1) % num_brokers))
+             for p in range(8)]
+    return FakeMetadataBackend(brokers, parts)
+
+
+def test_planner_task_types():
+    planner = ExecutionTaskPlanner()
+    tasks = planner.add_proposals([
+        proposal("T", 0, [0, 1], [2, 1]),       # replica move
+        proposal("T", 1, [0, 1], [1, 0]),       # pure leadership
+    ])
+    types = sorted((t.task_type for t in tasks), key=lambda t: t.value)
+    assert types == [TaskType.INTER_BROKER_REPLICA_ACTION, TaskType.LEADER_ACTION]
+
+
+def test_planner_respects_per_broker_caps():
+    planner = ExecutionTaskPlanner()
+    planner.add_proposals([
+        proposal("T", 0, [0, 1], [2, 1]),
+        proposal("T", 1, [0, 1], [3, 1]),
+        proposal("T", 2, [0, 1], [2, 1]),
+    ])
+    ready = {b: 1 for b in range(4)}
+    batch = planner.inter_broker_tasks(ready, {})
+    # Every proposal involves brokers 0 and 1 — cap 1 allows only one task.
+    assert len(batch) == 1
+    assert len(planner.remaining_inter_broker_tasks) == 2
+
+
+def test_strategies_order():
+    small = proposal("T", 0, [0], [1], size=10)
+    large = proposal("T", 1, [0], [1], size=1000)
+    t_small = ExecutionTask(small, TaskType.INTER_BROKER_REPLICA_ACTION)
+    t_large = ExecutionTask(large, TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert PrioritizeLargeReplicaMovementStrategy().order(
+        [t_small, t_large])[0] is t_large
+    assert PrioritizeSmallReplicaMovementStrategy().order(
+        [t_large, t_small])[0] is t_small
+    urp = PostponeUrpReplicaMovementStrategy({("T", 1)})
+    assert urp.order([t_large, t_small])[0] is t_small
+
+
+def test_task_state_machine():
+    t = ExecutionTask(proposal("T", 0, [0], [1]),
+                      TaskType.INTER_BROKER_REPLICA_ACTION)
+    t.transition(ExecutionTaskState.IN_PROGRESS, 1.0)
+    with pytest.raises(ValueError):
+        t.transition(ExecutionTaskState.PENDING)
+    t.transition(ExecutionTaskState.COMPLETED, 2.0)
+    assert t.done
+
+
+def test_executor_end_to_end():
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=2)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.001))
+    props = [
+        proposal("T", 0, [0, 1], [2, 1]),
+        proposal("T", 1, [1, 2], [3, 2]),
+        proposal("T", 2, [2, 3], [3, 2]),       # leadership only
+    ]
+    ex.execute_proposals(props, wait=True)
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    # Metadata reflects the new assignments.
+    cluster = md.fetch()
+    by_tp = {(p.topic, p.partition): p for p in cluster.partitions}
+    assert by_tp[("T", 0)].replicas == (2, 1)
+    assert by_tp[("T", 1)].replicas == (3, 2)
+    assert by_tp[("T", 2)].leader == 3
+    summary = ex.tracker.summary()
+    assert summary["inter_broker_replica"]["completed"] == 2
+    assert summary["leadership"]["completed"] == 1
+    assert ex.tracker.finished_data_movement_mb > 0
+
+
+def test_executor_rejects_concurrent_execution():
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=50)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=False)
+    with pytest.raises(OngoingExecutionError):
+        ex.execute_proposals([proposal("T", 1, [1, 2], [3, 2])])
+    ex.user_triggered_stop_execution()
+    ex._thread.join(timeout=5)
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_executor_refuses_external_reassignment():
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=10)
+    # Simulate an externally-started reassignment.
+    ext = ExecutionTask(proposal("T", 7, [0], [1]),
+                        TaskType.INTER_BROKER_REPLICA_ACTION)
+    backend.execute_replica_reassignments([ext])
+    ex = Executor(backend)
+    with pytest.raises(OngoingExecutionError):
+        ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])])
+
+
+def test_executor_stop_marks_pending_dead():
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=1000)
+    cfg = ExecutorConfig(progress_check_interval_s=0.001,
+                         concurrent_partition_movements_per_broker=1)
+    ex = Executor(backend, cfg)
+    props = [proposal("T", i, [0, 1], [2 + (i % 2), 1]) for i in range(4)]
+    ex.execute_proposals(props, wait=False)
+    import time
+    time.sleep(0.05)
+    ex.user_triggered_stop_execution()
+    ex._thread.join(timeout=5)
+    s = ex.tracker.summary()["inter_broker_replica"]
+    assert s.get("aborted", 0) + s.get("dead", 0) >= 1
+
+
+def test_generating_proposals_guard():
+    md = _metadata()
+    ex = Executor(FakeClusterBackend(md))
+    ex.set_generating_proposals_for_execution(True)
+    with pytest.raises(OngoingExecutionError):
+        ex.set_generating_proposals_for_execution(True)
+    ex.set_generating_proposals_for_execution(False)
+
+
+def test_throttles_set_and_cleared():
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=1)
+    cfg = ExecutorConfig(progress_check_interval_s=0.001,
+                         replication_throttle_bytes_per_s=1_000_000)
+    ex = Executor(backend, cfg)
+    seen = {}
+    orig = backend.set_throttles
+
+    def spy(rate, partitions):
+        seen["rate"] = rate
+        seen["partitions"] = list(partitions)
+        orig(rate, partitions)
+
+    backend.set_throttles = spy
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+    assert seen["rate"] == 1_000_000
+    assert backend.throttle_rate is None      # cleared after execution

@@ -1,0 +1,143 @@
+"""Façade integration tests — the full wiring: monitor → analyzer → executor
+→ detectors, against the fake cluster backend (the reference's
+CruiseControlIntegrationTestHarness role, minus HTTP)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.exceptions import OngoingExecutionError
+from cruise_control_tpu.detector.anomalies import AnomalyType, MaintenanceEvent
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.executor.backend import FakeClusterBackend
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    MetadataClient,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+
+W = 1000
+
+
+def build_stack(num_brokers=4, partitions=8, rf=2, self_healing=False):
+    brokers = [BrokerInfo(i, rack=str(i % 2), host=f"h{i}")
+               for i in range(num_brokers)]
+    parts = [PartitionInfo("T", p, leader=p % num_brokers,
+                           replicas=tuple((p + i) % num_brokers for i in range(rf)),
+                           in_sync=(p % num_brokers,))
+             for p in range(partitions)]
+    backend = FakeMetadataBackend(brokers, parts)
+    client = MetadataClient(backend, ttl_ms=0)
+    lm = LoadMonitor(client, num_windows=5, window_ms=W, min_samples_per_window=1)
+    runner = LoadMonitorTaskRunner(lm, SyntheticWorkloadSampler(),
+                                   sampling_interval_ms=W)
+    runner.bootstrap(0, 6 * W)
+    cluster = FakeClusterBackend(backend, polls_to_finish=1)
+    ex = Executor(cluster, ExecutorConfig(progress_check_interval_s=0.001))
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=self_healing, clock=lambda: time.time() * 1000,
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    cc = CruiseControl(lm, ex, task_runner=runner, notifier=notifier)
+    return cc, backend, cluster
+
+
+def _wait_executor_idle(cc, timeout=10.0):
+    deadline = time.time() + timeout
+    while cc.executor.has_ongoing_execution and time.time() < deadline:
+        time.sleep(0.01)
+    assert not cc.executor.has_ongoing_execution
+
+
+def test_rebalance_dryrun_and_state():
+    cc, backend, cluster = build_stack()
+    r = cc.rebalance(goals=["ReplicaDistributionGoal"], dryrun=True)
+    assert r.dryrun and not r.executed
+    s = cc.state()
+    assert s["MonitorState"]["numValidWindows"] == 5
+    assert s["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+    assert cc.broker_stats()["numBrokers"] == 4
+    assert len(cc.partition_load(max_entries=5)) == 5
+
+
+def test_remove_broker_executes_against_cluster():
+    cc, backend, cluster = build_stack()
+    r = cc.remove_brokers([3], goals=["RackAwareGoal", "ReplicaCapacityGoal"],
+                          dryrun=False)
+    assert r.executed
+    _wait_executor_idle(cc)
+    md = backend.fetch()
+    for p in md.partitions:
+        assert 3 not in p.replicas
+    # Executor went back to idle and sampled reassignments happened.
+    assert len(cluster.reassignment_log) == len(r.optimizer_result.proposals)
+
+
+def test_demote_broker_moves_leadership():
+    cc, backend, cluster = build_stack()
+    r = cc.demote_brokers([0], dryrun=False)
+    if r.executed:
+        _wait_executor_idle(cc)
+        md = backend.fetch()
+        assert all(p.leader != 0 for p in md.partitions)
+
+
+def test_topic_rf_change():
+    cc, backend, cluster = build_stack()
+    r = cc.change_topic_replication_factor(
+        "T", 3, goals=["RackAwareDistributionGoal", "ReplicaCapacityGoal"],
+        dryrun=False)
+    assert r.optimizer_result is not None
+    if r.executed:
+        _wait_executor_idle(cc)
+        md = backend.fetch()
+        for p in md.partitions:
+            assert len(p.replicas) == 3
+
+
+def test_concurrent_operation_guard():
+    cc, backend, cluster = build_stack()
+    cluster.polls_to_finish = 500
+    r = cc.remove_brokers([3], goals=["ReplicaCapacityGoal"], dryrun=False)
+    assert r.executed
+    with pytest.raises(OngoingExecutionError):
+        cc.rebalance(dryrun=False)
+    cc.stop_execution()
+    _wait_executor_idle(cc)
+
+
+def test_self_healing_broker_failure_end_to_end():
+    cc, backend, cluster = build_stack(self_healing=True)
+    backend.kill_broker(2)
+    n = cc.anomaly_detector.run_detection_once()
+    assert n >= 1
+    _wait_executor_idle(cc)
+    md = backend.fetch()
+    for p in md.partitions:
+        assert 2 not in p.replicas, f"{p} still references dead broker"
+    summary = cc.anomaly_detector.state_summary()
+    assert summary["metrics"].get("FIX_STARTED", 0) >= 1
+
+
+def test_maintenance_event_routes_through_fixer():
+    cc, backend, cluster = build_stack(self_healing=True)
+    det = cc.anomaly_detector.detectors[AnomalyType.MAINTENANCE_EVENT]
+    det.submit(MaintenanceEvent(plan="remove_broker", broker_ids=(1,)))
+    cc.anomaly_detector.run_detection_once()
+    _wait_executor_idle(cc)
+    md = backend.fetch()
+    for p in md.partitions:
+        assert 1 not in p.replicas
+
+
+def test_self_healing_toggle():
+    cc, *_ = build_stack(self_healing=False)
+    assert cc.set_self_healing(AnomalyType.BROKER_FAILURE, True) is False
+    assert cc.notifier.self_healing_enabled()[AnomalyType.BROKER_FAILURE] is True
