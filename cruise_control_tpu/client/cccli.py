@@ -1,43 +1,146 @@
 """``tpucc`` — command-line client.
 
 Reference: ``cruise-control-client/cruisecontrolclient/client/cccli.py`` (the
-``cccli`` console script).  Subcommands mirror the REST endpoints; offline
-subcommands (``propose``) run the analyzer locally on a snapshot file without
-a server — the round-1 end-to-end slice.
+``cccli`` console script), ``client/Endpoint.py:14-430`` (one spec per REST
+endpoint with its allowed parameters) and ``client/Responder.py`` (HTTP with
+progress polling on 202 responses).  The offline ``propose`` subcommand runs
+the analyzer locally on a snapshot file without a server — the round-1
+end-to-end slice.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+USER_TASK_HEADER = "User-Task-ID"
+
+
+@dataclass(frozen=True)
+class EndpointSpec:
+    """One REST endpoint: method + the parameters it accepts
+    (client/Endpoint.py's Endpoint classes)."""
+
+    name: str
+    method: str
+    params: Tuple[str, ...] = ()
+    help: str = ""
+
+
+ENDPOINTS: Dict[str, EndpointSpec] = {e.name: e for e in [
+    EndpointSpec("state", "GET", ("verbose",), "cruise control state"),
+    EndpointSpec("load", "GET", (), "broker-level load stats"),
+    EndpointSpec("partition_load", "GET", ("entries",), "per-partition loads"),
+    EndpointSpec("kafka_cluster_state", "GET", (), "broker/partition state"),
+    EndpointSpec("user_tasks", "GET", (), "async task list"),
+    EndpointSpec("review_board", "GET", (), "two-step review board"),
+    EndpointSpec("proposals", "GET", ("goals", "excluded_topics"),
+                 "compute (cached) proposals"),
+    EndpointSpec("bootstrap", "GET", ("start", "end"), "re-ingest sample range"),
+    EndpointSpec("train", "GET", ("start", "end"), "train the CPU model"),
+    EndpointSpec("rebalance", "POST", ("dryrun", "goals", "excluded_topics",
+                                       "destination_broker_ids"), "rebalance"),
+    EndpointSpec("add_broker", "POST", ("brokerid", "dryrun", "goals"),
+                 "move load onto new brokers"),
+    EndpointSpec("remove_broker", "POST", ("brokerid", "dryrun", "goals"),
+                 "decommission brokers"),
+    EndpointSpec("demote_broker", "POST", ("brokerid", "dryrun"),
+                 "move leadership off brokers"),
+    EndpointSpec("fix_offline_replicas", "POST", ("dryrun", "goals"),
+                 "relocate offline replicas"),
+    EndpointSpec("topic_configuration", "POST",
+                 ("topic", "replication_factor", "dryrun", "goals"),
+                 "change topic replication factor"),
+    EndpointSpec("stop_proposal_execution", "POST", (), "stop ongoing execution"),
+    EndpointSpec("pause_sampling", "POST", ("reason",), "pause metric sampling"),
+    EndpointSpec("resume_sampling", "POST", ("reason",), "resume metric sampling"),
+    EndpointSpec("admin", "POST", ("enable_self_healing_for",
+                                   "disable_self_healing_for",
+                                   "concurrent_partition_movements_per_broker"),
+                 "admin toggles"),
+    EndpointSpec("review", "POST", ("approve", "discard", "reason"),
+                 "approve/discard parked requests"),
+]}
+
+
+class Responder:
+    """HTTP with 202 progress polling (client/Responder.py semantics)."""
+
+    def __init__(self, base_url: str, poll_interval_s: float = 0.5,
+                 max_wait_s: float = 600.0):
+        self.base = base_url.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+        self.max_wait_s = max_wait_s
+
+    def request(self, spec: EndpointSpec, params: Dict[str, str]) -> Dict:
+        qs = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
+        url = f"{self.base}/kafkacruisecontrol/{spec.name}"
+        if qs:
+            url += f"?{qs}"
+        task_id: Optional[str] = None
+        deadline = time.time() + self.max_wait_s
+        while True:
+            req = urllib.request.Request(url, method=spec.method)
+            if task_id:
+                req.add_header(USER_TASK_HEADER, task_id)
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    payload = json.loads(resp.read().decode())
+                    status = resp.status
+                    task_id = resp.headers.get(USER_TASK_HEADER, task_id)
+            except urllib.error.HTTPError as e:
+                return {"httpStatus": e.code,
+                        **json.loads(e.read().decode() or "{}")}
+            if status != 202 or time.time() > deadline:
+                payload["httpStatus"] = status
+                return payload
+            time.sleep(self.poll_interval_s)
 
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
-        prog="tpucc",
-        description="TPU-native Cruise Control client",
-    )
+        prog="tpucc", description="TPU-native Cruise Control client")
+    parser.add_argument("-a", "--address", default="http://127.0.0.1:9090",
+                        help="server base URL")
     sub = parser.add_subparsers(dest="command")
     sub.required = False
 
-    propose = sub.add_parser("propose", help="compute rebalance proposals for a snapshot file")
-    propose.add_argument("--snapshot", required=True, help="path to a cluster snapshot (.json)")
+    propose = sub.add_parser("propose",
+                             help="offline: compute proposals for a snapshot file")
+    propose.add_argument("--snapshot", required=True,
+                         help="path to a cluster snapshot (.json or .npz)")
     propose.add_argument("--goals", default=None,
-                         help="comma-separated goal names (default: default.goals config)")
+                         help="comma-separated goal names")
     propose.add_argument("--verbose", action="store_true")
+
+    for spec in ENDPOINTS.values():
+        p = sub.add_parser(spec.name, help=spec.help)
+        for param in spec.params:
+            p.add_argument(f"--{param}", default=None)
     return parser
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.command is None:
-        build_parser().print_help()
+        parser.print_help()
         return 0
     if args.command == "propose":
         # Imported lazily: jax startup is slow and irrelevant for --help.
         from cruise_control_tpu.client.propose import run_propose
         return run_propose(args)
-    return 1
+    spec = ENDPOINTS[args.command]
+    params = {p: getattr(args, p, None) for p in spec.params}
+    result = Responder(args.address).request(spec, params)
+    print(json.dumps(result, indent=2))
+    return 0 if result.get("httpStatus", 200) < 400 else 1
 
 
 if __name__ == "__main__":

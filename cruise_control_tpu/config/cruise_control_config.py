@@ -1,0 +1,222 @@
+"""The framework's config surface.
+
+Reference: ``config/KafkaCruiseControlConfig.java`` over the per-subsystem
+constants classes — ``AnalyzerConfig`` (611), ``MonitorConfig`` (559),
+``ExecutorConfig`` (614), ``AnomalyDetectorConfig`` (417),
+``WebServerConfig`` (495).  Key names match the reference property names so a
+reference ``cruisecontrol.properties`` file parses directly; goal lists
+accept fully-qualified Java class names (the registry strips packages) —
+the ``goals``/``default.goals`` switch-in point BASELINE.json requires.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.registry import (
+    DEFAULT_ANOMALY_DETECTION_GOALS,
+    DEFAULT_GOALS,
+    DEFAULT_HARD_GOALS,
+    DEFAULT_INTRA_BROKER_GOALS,
+    SUPPORTED_GOALS,
+)
+from cruise_control_tpu.common.exceptions import ConfigError
+from cruise_control_tpu.config.config_def import (
+    ConfigDef,
+    ConfigType,
+    load_properties,
+    range_validator,
+)
+from cruise_control_tpu.executor.executor import ExecutorConfig
+
+
+def _analyzer_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("default.goals", ConfigType.LIST, ",".join(DEFAULT_GOALS),
+             doc="goal priority list used when a request names no goals")
+    d.define("goals", ConfigType.LIST, ",".join(SUPPORTED_GOALS),
+             doc="all goals the instance supports")
+    d.define("hard.goals", ConfigType.LIST, ",".join(DEFAULT_HARD_GOALS))
+    d.define("intra.broker.goals", ConfigType.LIST,
+             ",".join(DEFAULT_INTRA_BROKER_GOALS))
+    d.define("cpu.balance.threshold", ConfigType.DOUBLE, 1.1,
+             range_validator(1.0))
+    d.define("network.inbound.balance.threshold", ConfigType.DOUBLE, 1.1,
+             range_validator(1.0))
+    d.define("network.outbound.balance.threshold", ConfigType.DOUBLE, 1.1,
+             range_validator(1.0))
+    d.define("disk.balance.threshold", ConfigType.DOUBLE, 1.1, range_validator(1.0))
+    d.define("cpu.capacity.threshold", ConfigType.DOUBLE, 0.7,
+             range_validator(0.0, 1.0))
+    d.define("network.inbound.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             range_validator(0.0, 1.0))
+    d.define("network.outbound.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             range_validator(0.0, 1.0))
+    d.define("disk.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             range_validator(0.0, 1.0))
+    d.define("cpu.low.utilization.threshold", ConfigType.DOUBLE, 0.0)
+    d.define("network.inbound.low.utilization.threshold", ConfigType.DOUBLE, 0.0)
+    d.define("network.outbound.low.utilization.threshold", ConfigType.DOUBLE, 0.0)
+    d.define("disk.low.utilization.threshold", ConfigType.DOUBLE, 0.0)
+    d.define("max.replicas.per.broker", ConfigType.LONG, 10_000,
+             range_validator(1))
+    d.define("replica.count.balance.threshold", ConfigType.DOUBLE, 1.1)
+    d.define("leader.replica.count.balance.threshold", ConfigType.DOUBLE, 1.1)
+    d.define("topic.replica.count.balance.threshold", ConfigType.DOUBLE, 3.0)
+    d.define("topic.names.with.min.leaders.per.broker", ConfigType.LIST, "")
+    d.define("min.topic.leaders.per.broker", ConfigType.INT, 1)
+    d.define("proposal.expiration.ms", ConfigType.LONG, 60_000)
+    d.define("goal.violation.distribution.threshold.multiplier",
+             ConfigType.DOUBLE, 1.0)
+    d.define("num.proposal.precompute.threads", ConfigType.INT, 1)
+    return d
+
+
+def _monitor_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("num.partition.metrics.windows", ConfigType.INT, 5)
+    d.define("partition.metrics.window.ms", ConfigType.LONG, 300_000)
+    d.define("num.broker.metrics.windows", ConfigType.INT, 20)
+    d.define("broker.metrics.window.ms", ConfigType.LONG, 300_000)
+    d.define("min.samples.per.partition.metrics.window", ConfigType.INT, 1)
+    d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000)
+    d.define("monitor.state.update.interval.ms", ConfigType.LONG, 30_000)
+    d.define("broker.capacity.config.resolver.class", ConfigType.CLASS, "")
+    d.define("capacity.config.file", ConfigType.STRING, "")
+    d.define("sample.store.class", ConfigType.CLASS, "")
+    d.define("sample.store.dir", ConfigType.STRING, "")
+    d.define("metric.sampler.class", ConfigType.CLASS, "")
+    d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
+             range_validator(0.0, 1.0))
+    d.define("metadata.max.age.ms", ConfigType.LONG, 5_000)
+    return d
+
+
+def _executor_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT, 5)
+    d.define("num.concurrent.intra.broker.partition.movements", ConfigType.INT, 2)
+    d.define("num.concurrent.leader.movements", ConfigType.INT, 1000)
+    d.define("max.num.cluster.movements", ConfigType.INT, 1250)
+    d.define("execution.progress.check.interval.ms", ConfigType.LONG, 10_000)
+    d.define("default.replication.throttle", ConfigType.LONG, None)
+    d.define("task.execution.alerting.threshold.ms", ConfigType.LONG, 90_000)
+    d.define("auto.adjust.concurrency", ConfigType.BOOLEAN, False)
+    return d
+
+
+def _anomaly_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("anomaly.detection.goals", ConfigType.LIST,
+             ",".join(DEFAULT_ANOMALY_DETECTION_GOALS))
+    d.define("anomaly.detection.interval.ms", ConfigType.LONG, 300_000)
+    d.define("self.healing.enabled", ConfigType.BOOLEAN, False)
+    d.define("broker.failure.alert.threshold.ms", ConfigType.LONG, 900_000)
+    d.define("broker.failure.self.healing.threshold.ms", ConfigType.LONG, 1_800_000)
+    d.define("anomaly.notifier.class", ConfigType.CLASS, "")
+    d.define("topic.anomaly.target.replication.factor", ConfigType.INT, None)
+    return d
+
+
+def _webserver_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("webserver.http.port", ConfigType.INT, 9090)
+    d.define("webserver.http.address", ConfigType.STRING, "127.0.0.1")
+    d.define("webserver.api.urlprefix", ConfigType.STRING, "/kafkacruisecontrol/*")
+    d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000)
+    d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 21_600_000)
+    d.define("max.active.user.tasks", ConfigType.INT, 25)
+    d.define("completed.user.task.retention.time.ms", ConfigType.LONG, 86_400_000)
+    d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False)
+    return d
+
+
+class CruiseControlConfig:
+    """Parsed config over the merged per-subsystem definitions."""
+
+    def __init__(self, props: Optional[Dict[str, Any]] = None):
+        self.definition = (_analyzer_def().merge(_monitor_def())
+                           .merge(_executor_def()).merge(_anomaly_def())
+                           .merge(_webserver_def()))
+        props = dict(props or {})
+        known = self.definition.keys()
+        self.originals = props
+        self.values = self.definition.parse(
+            {k: v for k, v in props.items() if k in known})
+        self._validate_goal_names()
+
+    @classmethod
+    def from_properties_file(cls, path: str) -> "CruiseControlConfig":
+        return cls(load_properties(path))
+
+    def _validate_goal_names(self) -> None:
+        from cruise_control_tpu.analyzer.goals.registry import goal_by_name
+        for key in ("default.goals", "goals", "hard.goals",
+                    "anomaly.detection.goals", "intra.broker.goals"):
+            for name in self.values.get(key) or []:
+                try:
+                    goal_by_name(name)
+                except ValueError as e:
+                    raise ConfigError(f"{key}: {e}") from None
+
+    def __getitem__(self, key: str) -> Any:
+        return self.values[key]
+
+    def get(self, key: str, default=None) -> Any:
+        return self.values.get(key, default)
+
+    # ----------------------------------------------------- derived objects
+
+    def goal_names(self, key: str = "default.goals") -> List[str]:
+        return [g.rsplit(".", 1)[-1] for g in self.values[key]]
+
+    def balancing_constraint(self) -> BalancingConstraint:
+        v = self.values
+        return BalancingConstraint(
+            balance_threshold=np.array(
+                [v["cpu.balance.threshold"],
+                 v["network.inbound.balance.threshold"],
+                 v["network.outbound.balance.threshold"],
+                 v["disk.balance.threshold"]], dtype=np.float32),
+            capacity_threshold=np.array(
+                [v["cpu.capacity.threshold"],
+                 v["network.inbound.capacity.threshold"],
+                 v["network.outbound.capacity.threshold"],
+                 v["disk.capacity.threshold"]], dtype=np.float32),
+            low_utilization_threshold=np.array(
+                [v["cpu.low.utilization.threshold"],
+                 v["network.inbound.low.utilization.threshold"],
+                 v["network.outbound.low.utilization.threshold"],
+                 v["disk.low.utilization.threshold"]], dtype=np.float32),
+            max_replicas_per_broker=int(v["max.replicas.per.broker"]),
+            replica_balance_threshold=v["replica.count.balance.threshold"],
+            leader_replica_balance_threshold=
+                v["leader.replica.count.balance.threshold"],
+            topic_replica_balance_threshold=
+                v["topic.replica.count.balance.threshold"],
+            min_topic_leaders_per_broker=v["min.topic.leaders.per.broker"],
+            min_leader_topic_names=tuple(
+                v["topic.names.with.min.leaders.per.broker"] or ()),
+            goal_violation_distribution_threshold_multiplier=
+                v["goal.violation.distribution.threshold.multiplier"],
+        )
+
+    def executor_config(self) -> ExecutorConfig:
+        v = self.values
+        return ExecutorConfig(
+            concurrent_partition_movements_per_broker=
+                v["num.concurrent.partition.movements.per.broker"],
+            concurrent_intra_broker_partition_movements=
+                v["num.concurrent.intra.broker.partition.movements"],
+            concurrent_leader_movements=v["num.concurrent.leader.movements"],
+            max_num_cluster_movements=v["max.num.cluster.movements"],
+            progress_check_interval_s=
+                v["execution.progress.check.interval.ms"] / 1000.0,
+            replication_throttle_bytes_per_s=v["default.replication.throttle"],
+            task_execution_alert_timeout_s=
+                v["task.execution.alerting.threshold.ms"] / 1000.0,
+            auto_adjust_concurrency=v["auto.adjust.concurrency"],
+        )

@@ -1,0 +1,126 @@
+"""Application bootstrap.
+
+Reference: ``KafkaCruiseControlMain.java:26-41`` / ``KafkaCruiseControlApp``
+— parse config, wire the component stack, start the HTTP server.  The
+cluster-facing seams (metadata backend, metric sampler, admin backend) are
+chosen by config; ``--demo`` wires the in-process fake cluster so the full
+service runs standalone (the role of the reference's embedded-broker harness).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+from typing import Optional
+
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.executor.backend import FakeClusterBackend
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityConfigFileResolver,
+    FixedBrokerCapacityResolver,
+)
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    MetadataClient,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.sample_store import FileSampleStore, NoopSampleStore
+from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+from cruise_control_tpu.servlet.server import CruiseControlApp
+
+
+def demo_metadata(num_brokers: int = 6, num_partitions: int = 48,
+                  rf: int = 2) -> FakeMetadataBackend:
+    brokers = [BrokerInfo(i, rack=str(i % 3), host=f"host{i}")
+               for i in range(num_brokers)]
+    parts = [PartitionInfo("demo-topic", p, leader=p % num_brokers,
+                           replicas=tuple((p + i) % num_brokers for i in range(rf)),
+                           in_sync=tuple((p + i) % num_brokers for i in range(rf)))
+             for p in range(num_partitions)]
+    return FakeMetadataBackend(brokers, parts)
+
+
+def build_app(config: CruiseControlConfig, demo: bool = True,
+              port: Optional[int] = None) -> CruiseControlApp:
+    backend = demo_metadata()
+    metadata_client = MetadataClient(backend,
+                                     ttl_ms=config["metadata.max.age.ms"])
+    capacity_file = config.get("capacity.config.file")
+    resolver = (BrokerCapacityConfigFileResolver(capacity_file)
+                if capacity_file else None)
+    load_monitor = LoadMonitor(
+        metadata_client,
+        capacity_resolver=resolver,
+        num_windows=config["num.partition.metrics.windows"],
+        window_ms=config["partition.metrics.window.ms"],
+        min_samples_per_window=config["min.samples.per.partition.metrics.window"],
+    )
+    store_dir = config.get("sample.store.dir")
+    store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
+    task_runner = LoadMonitorTaskRunner(
+        load_monitor, SyntheticWorkloadSampler(), store,
+        sampling_interval_ms=config["metric.sampling.interval.ms"])
+    executor = Executor(FakeClusterBackend(backend),
+                        config.executor_config())
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=config["self.healing.enabled"],
+        broker_failure_alert_threshold_ms=
+            config["broker.failure.alert.threshold.ms"],
+        broker_failure_self_healing_threshold_ms=
+            config["broker.failure.self.healing.threshold.ms"])
+    cc = CruiseControl(
+        load_monitor, executor, task_runner=task_runner,
+        constraint=config.balancing_constraint(),
+        default_goals=config.goal_names("default.goals"),
+        notifier=notifier,
+        self_healing_goals=config.goal_names("anomaly.detection.goals"),
+        anomaly_detection_interval_s=
+            config["anomaly.detection.interval.ms"] / 1000.0)
+    app = CruiseControlApp(
+        cc,
+        host=config["webserver.http.address"],
+        port=port if port is not None else config["webserver.http.port"],
+        two_step_verification=config["two.step.verification.enabled"],
+        max_active_user_tasks=config["max.active.user.tasks"])
+    return app
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="cruise-control-tpu")
+    parser.add_argument("--config", help="properties file", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--demo", action="store_true",
+                        help="run against the in-process fake cluster")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = (CruiseControlConfig.from_properties_file(args.config)
+              if args.config else CruiseControlConfig())
+    app = build_app(config, demo=True, port=args.port)
+    app.cc.start_up()
+    app.start()
+    print(f"cruise-control-tpu listening on "
+          f"http://{config['webserver.http.address']}:{app.port}"
+          f"{'' } (demo cluster)", flush=True)
+    stop = [False]
+    signal.signal(signal.SIGTERM, lambda *a: stop.__setitem__(0, True))
+    signal.signal(signal.SIGINT, lambda *a: stop.__setitem__(0, True))
+    try:
+        while not stop[0]:
+            time.sleep(0.5)
+    finally:
+        app.stop()
+        app.cc.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

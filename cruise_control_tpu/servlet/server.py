@@ -1,0 +1,388 @@
+"""REST API server.
+
+Reference: ``servlet/KafkaCruiseControlServlet.java:107-219`` dispatch over
+the endpoint enum (``CruiseControlEndPoint.java:17-36``), parameter parsing
+(``servlet/parameters/ParameterUtils.java``), async 202-until-done responses
+via UserTaskManager, and two-step verification through the Purgatory.
+
+Implementation: stdlib ThreadingHTTPServer — the service is control-plane
+(tens of requests/min), so a dependency-free server keeps the runtime
+hermetic; the layering (app → façade → components) mirrors
+``KafkaCruiseControlApp``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.analyzer import OptimizationOptions
+from cruise_control_tpu.common.exceptions import (
+    CruiseControlError,
+    OngoingExecutionError,
+    UserRequestError,
+)
+from cruise_control_tpu.detector.anomalies import AnomalyType
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.servlet.purgatory import Purgatory
+from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
+
+LOG = logging.getLogger(__name__)
+
+URL_PREFIX = "/kafkacruisecontrol/"
+USER_TASK_HEADER = "User-Task-ID"
+
+GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
+                 "state", "kafka_cluster_state", "user_tasks", "review_board"}
+POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
+                  "rebalance", "stop_proposal_execution", "pause_sampling",
+                  "resume_sampling", "demote_broker", "admin", "review",
+                  "topic_configuration"}
+# POSTs subject to two-step verification (mutating cluster state).
+REVIEWABLE = {"add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
+              "demote_broker", "topic_configuration"}
+
+
+def _parse_params(query: str) -> Dict[str, str]:
+    return {k.lower(): v[-1] for k, v in urllib.parse.parse_qs(query).items()}
+
+
+def _bool(params: Dict[str, str], name: str, default: bool) -> bool:
+    raw = params.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("true", "1", "yes")
+
+
+def _ints(params: Dict[str, str], name: str) -> List[int]:
+    raw = params.get(name, "")
+    return [int(x) for x in raw.split(",") if x.strip()]
+
+
+def _goals(params: Dict[str, str]) -> Optional[List[str]]:
+    raw = params.get("goals", "")
+    names = [g.strip().rsplit(".", 1)[-1] for g in raw.split(",") if g.strip()]
+    return names or None
+
+
+def _options(params: Dict[str, str]) -> OptimizationOptions:
+    return OptimizationOptions(
+        excluded_topics=frozenset(
+            t for t in params.get("excluded_topics", "").split(",") if t),
+        requested_destination_broker_ids=frozenset(
+            _ints(params, "destination_broker_ids")),
+        only_move_immigrant_replicas=_bool(
+            params, "only_move_immigrant_replicas", False),
+    )
+
+
+class CruiseControlApp:
+    """HTTP front over the façade (KafkaCruiseControlApp.java:36-68)."""
+
+    def __init__(self, cc: CruiseControl, host: str = "127.0.0.1", port: int = 0,
+                 two_step_verification: bool = False,
+                 max_active_user_tasks: int = 25):
+        self.cc = cc
+        self.user_tasks = UserTaskManager(max_active_tasks=max_active_user_tasks)
+        self.purgatory = Purgatory() if two_step_verification else None
+        handler = _make_handler(self)
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="http-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.user_tasks.shutdown()
+
+    # ------------------------------------------------------------ endpoints
+
+    def handle(self, method: str, endpoint: str, params: Dict[str, str],
+               task_id: Optional[str]) -> Tuple[int, Dict, Dict[str, str]]:
+        """(status, body, extra_headers)."""
+        if method == "GET" and endpoint not in GET_ENDPOINTS:
+            return 404, {"error": f"unknown GET endpoint {endpoint}"}, {}
+        if method == "POST" and endpoint not in POST_ENDPOINTS:
+            return 404, {"error": f"unknown POST endpoint {endpoint}"}, {}
+
+        # Two-step verification: park reviewable POSTs without approval.
+        if (method == "POST" and self.purgatory is not None
+                and endpoint in REVIEWABLE):
+            review_id = params.get("review_id")
+            if review_id is None:
+                info = self.purgatory.add(
+                    endpoint, urllib.parse.urlencode(params))
+                return 202, {"reviewResult": info.to_dict(),
+                             "message": "pending review"}, {}
+            self.purgatory.take_approved(int(review_id))
+
+        handler = getattr(self, f"_ep_{endpoint}", None)
+        if handler is None:
+            return 501, {"error": f"{endpoint} not implemented"}, {}
+        return handler(params, task_id)
+
+    # ---- sync GETs
+
+    def _ep_state(self, params, task_id):
+        body = self.cc.state()
+        if not _bool(params, "verbose", False):
+            body["AnalyzerState"].pop("goalReadiness", None)
+        return 200, body, {}
+
+    def _ep_load(self, params, task_id):
+        return 200, self.cc.broker_stats(), {}
+
+    def _ep_partition_load(self, params, task_id):
+        n = int(params.get("entries", "100"))
+        return 200, {"records": self.cc.partition_load(max_entries=n)}, {}
+
+    def _ep_kafka_cluster_state(self, params, task_id):
+        md = self.cc.load_monitor.metadata_client.refresh_metadata()
+        return 200, {
+            "KafkaBrokerState": {
+                "Summary": {"brokers": len(md.brokers),
+                            "alive": len(md.alive_broker_ids())},
+                "brokers": [{"id": b.broker_id, "rack": b.rack, "host": b.host,
+                             "alive": b.alive} for b in md.brokers],
+            },
+            "KafkaPartitionState": {
+                "offline": [f"{p.topic}-{p.partition}" for p in md.partitions
+                            if p.leader is None],
+                "urp": [f"{p.topic}-{p.partition}" for p in md.partitions
+                        if len(p.in_sync) < len(p.replicas)],
+            },
+        }, {}
+
+    def _ep_user_tasks(self, params, task_id):
+        return 200, {"userTasks": [t.to_dict()
+                                   for t in self.user_tasks.all_tasks()]}, {}
+
+    def _ep_review_board(self, params, task_id):
+        if self.purgatory is None:
+            return 400, {"error": "two-step verification disabled"}, {}
+        return 200, {"RequestInfo": self.purgatory.board()}, {}
+
+    def _ep_bootstrap(self, params, task_id):
+        if self.cc.task_runner is None:
+            return 400, {"error": "no task runner"}, {}
+        start = float(params.get("start", 0))
+        end = float(params.get("end", 0))
+        n = self.cc.task_runner.bootstrap(start, end)
+        return 200, {"message": f"bootstrapped {n} samples"}, {}
+
+    def _ep_train(self, params, task_id):
+        from cruise_control_tpu.model.cpu_model import LinearRegressionCpuModel
+        model = LinearRegressionCpuModel(min_samples=1)
+        from cruise_control_tpu.monitor import metric_def as md
+        try:
+            result = self.cc.load_monitor.broker_aggregator.aggregate(
+                float(params.get("start", 0)), float(params.get("end", 1e18)))
+        except CruiseControlError as e:
+            return 400, {"error": str(e)}, {}
+        bdef = md.BROKER_METRIC_DEF
+        for _, vae in result.values_and_extrapolations.items():
+            for w in range(vae.values.shape[1]):
+                model.add_sample(
+                    vae.values[bdef.metric_id("LEADER_BYTES_IN"), w],
+                    vae.values[bdef.metric_id("LEADER_BYTES_OUT"), w],
+                    vae.values[bdef.metric_id("REPLICATION_BYTES_IN_RATE"), w],
+                    vae.values[bdef.metric_id("CPU_USAGE"), w])
+        coef = model.fit()
+        return 200, {"message": "training done",
+                     "coefficients": None if coef is None else coef.tolist()}, {}
+
+    # ---- async operations (202-until-done)
+
+    def _async(self, endpoint: str, params: Dict[str, str], task_id: Optional[str],
+               op: Callable) -> Tuple[int, Dict, Dict[str, str]]:
+        query = urllib.parse.urlencode(params)
+        task = self.user_tasks.get_or_create(task_id, endpoint, query,
+                                             lambda progress: op())
+        headers = {USER_TASK_HEADER: task.task_id}
+        if task.state is TaskState.ACTIVE:
+            try:
+                result = task.future.result(timeout=5.0)
+                return 200, self._render(result), headers
+            except TimeoutError:
+                return 202, {"progress": task.progress.to_list(),
+                             "message": "operation in progress"}, headers
+            except CruiseControlError as e:
+                return 500, {"error": type(e).__name__, "message": str(e)}, headers
+        if task.state is TaskState.COMPLETED_WITH_ERROR:
+            e = task.future.exception()
+            code = 409 if isinstance(e, OngoingExecutionError) else 500
+            return code, {"error": type(e).__name__, "message": str(e)}, headers
+        return 200, self._render(task.future.result()), headers
+
+    @staticmethod
+    def _render(result) -> Dict:
+        return result.to_dict() if hasattr(result, "to_dict") else {"result": result}
+
+    def _ep_proposals(self, params, task_id):
+        goals = _goals(params)
+        options = _options(params)
+        return self._async("proposals", params, task_id,
+                           lambda: self.cc.proposals(goals, options))
+
+    def _ep_rebalance(self, params, task_id):
+        goals = _goals(params)
+        dryrun = _bool(params, "dryrun", True)
+        options = _options(params)
+        return self._async("rebalance", params, task_id,
+                           lambda: self.cc.rebalance(goals, dryrun, options))
+
+    def _ep_add_broker(self, params, task_id):
+        ids = _ints(params, "brokerid")
+        if not ids:
+            return 400, {"error": "brokerid parameter required"}, {}
+        return self._async("add_broker", params, task_id,
+                           lambda: self.cc.add_brokers(
+                               ids, _goals(params), _bool(params, "dryrun", True)))
+
+    def _ep_remove_broker(self, params, task_id):
+        ids = _ints(params, "brokerid")
+        if not ids:
+            return 400, {"error": "brokerid parameter required"}, {}
+        return self._async("remove_broker", params, task_id,
+                           lambda: self.cc.remove_brokers(
+                               ids, _goals(params), _bool(params, "dryrun", True)))
+
+    def _ep_demote_broker(self, params, task_id):
+        ids = _ints(params, "brokerid")
+        if not ids:
+            return 400, {"error": "brokerid parameter required"}, {}
+        return self._async("demote_broker", params, task_id,
+                           lambda: self.cc.demote_brokers(
+                               ids, _bool(params, "dryrun", True)))
+
+    def _ep_fix_offline_replicas(self, params, task_id):
+        return self._async("fix_offline_replicas", params, task_id,
+                           lambda: self.cc.fix_offline_replicas(
+                               _goals(params), _bool(params, "dryrun", True)))
+
+    def _ep_topic_configuration(self, params, task_id):
+        topic = params.get("topic")
+        rf = params.get("replication_factor")
+        if not topic or rf is None:
+            return 400, {"error": "topic and replication_factor required"}, {}
+        return self._async("topic_configuration", params, task_id,
+                           lambda: self.cc.change_topic_replication_factor(
+                               topic, int(rf), _goals(params),
+                               _bool(params, "dryrun", True)))
+
+    # ---- sync POSTs
+
+    def _ep_stop_proposal_execution(self, params, task_id):
+        self.cc.stop_execution()
+        return 200, {"message": "execution stop requested"}, {}
+
+    def _ep_pause_sampling(self, params, task_id):
+        try:
+            self.cc.pause_sampling(params.get("reason", "via API"))
+        except UserRequestError as e:
+            return 400, {"error": str(e)}, {}
+        return 200, {"message": "sampling paused"}, {}
+
+    def _ep_resume_sampling(self, params, task_id):
+        try:
+            self.cc.resume_sampling(params.get("reason", "via API"))
+        except UserRequestError as e:
+            return 400, {"error": str(e)}, {}
+        return 200, {"message": "sampling resumed"}, {}
+
+    def _ep_admin(self, params, task_id):
+        out: Dict[str, Any] = {}
+        if "enable_self_healing_for" in params:
+            for name in params["enable_self_healing_for"].split(","):
+                t = AnomalyType[name.strip().upper()]
+                out.setdefault("selfHealingEnabledBefore", {})[t.name] = \
+                    self.cc.set_self_healing(t, True)
+        if "disable_self_healing_for" in params:
+            for name in params["disable_self_healing_for"].split(","):
+                t = AnomalyType[name.strip().upper()]
+                out.setdefault("selfHealingEnabledBefore", {})[t.name] = \
+                    self.cc.set_self_healing(t, False)
+        if "concurrent_partition_movements_per_broker" in params:
+            n = int(params["concurrent_partition_movements_per_broker"])
+            self.cc.executor.config.concurrent_partition_movements_per_broker = n
+            out["concurrency"] = n
+        return 200, out or {"message": "no-op"}, {}
+
+    def _ep_review(self, params, task_id):
+        if self.purgatory is None:
+            return 400, {"error": "two-step verification disabled"}, {}
+        approve = _ints(params, "approve")
+        discard = _ints(params, "discard")
+        results = []
+        for rid in approve:
+            results.append(self.purgatory.review(
+                rid, True, params.get("reason", "")).to_dict())
+        for rid in discard:
+            results.append(self.purgatory.review(
+                rid, False, params.get("reason", "")).to_dict())
+        return 200, {"RequestInfo": results}, {}
+
+
+def _make_handler(app: CruiseControlApp):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):   # NCSA log → logger, not stderr
+            LOG.debug("http: " + fmt, *args)
+
+        def _dispatch(self, method: str):
+            parsed = urllib.parse.urlparse(self.path)
+            if not parsed.path.startswith(URL_PREFIX):
+                self._send(404, {"error": "not found"})
+                return
+            endpoint = parsed.path[len(URL_PREFIX):].strip("/").lower()
+            params = _parse_params(parsed.query)
+            if method == "POST" and self.headers.get("Content-Length"):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                ctype = self.headers.get("Content-Type", "")
+                if "application/x-www-form-urlencoded" in ctype:
+                    params.update(_parse_params(body.decode()))
+            task_id = self.headers.get(USER_TASK_HEADER)
+            try:
+                status, payload, headers = app.handle(method, endpoint, params,
+                                                      task_id)
+            except OngoingExecutionError as e:
+                status, payload, headers = 409, {"error": str(e)}, {}
+            except CruiseControlError as e:
+                status, payload, headers = 500, {
+                    "error": type(e).__name__, "message": str(e)}, {}
+            except Exception as e:       # noqa: BLE001 — never kill the server
+                LOG.exception("request failed")
+                status, payload, headers = 500, {
+                    "error": type(e).__name__, "message": str(e)}, {}
+            payload.setdefault("version", 1)
+            self._send(status, payload, headers)
+
+        def _send(self, status: int, payload: Dict,
+                  headers: Optional[Dict[str, str]] = None):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+    return Handler

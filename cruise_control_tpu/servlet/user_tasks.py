@@ -1,0 +1,118 @@
+"""Async user-task machinery.
+
+Reference: ``servlet/UserTaskManager.java:66-835`` (session → UUID mapping,
+active/completed task rings, per-endpoint retention, 202-until-done
+semantics) and ``servlet/handler/async/runnable/OperationFuture.java``.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.servlet.progress import OperationProgress
+
+
+class TaskState(enum.Enum):
+    ACTIVE = "Active"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+@dataclass
+class UserTask:
+    task_id: str
+    endpoint: str
+    query: str
+    future: Future
+    progress: OperationProgress
+    start_ms: float = field(default_factory=lambda: time.time() * 1000)
+    end_ms: float = 0.0
+
+    @property
+    def state(self) -> TaskState:
+        if not self.future.done():
+            return TaskState.ACTIVE
+        return (TaskState.COMPLETED_WITH_ERROR if self.future.exception()
+                else TaskState.COMPLETED)
+
+    def to_dict(self) -> Dict:
+        return {
+            "UserTaskId": self.task_id,
+            "RequestURL": f"{self.endpoint}?{self.query}" if self.query else self.endpoint,
+            "Status": self.state.value,
+            "StartMs": int(self.start_ms),
+        }
+
+
+class UserTaskManager:
+    """Runs operations on a pool; serves results/progress by task id."""
+
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_retention_ms: float = 86_400_000,
+                 num_threads: int = 4):
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="user-task")
+        self._tasks: Dict[str, UserTask] = {}
+        self._lock = threading.Lock()
+        self.max_active = max_active_tasks
+        self.retention_ms = completed_retention_ms
+
+    def submit(self, endpoint: str, query: str,
+               operation: Callable[[OperationProgress], Any],
+               task_id: Optional[str] = None) -> UserTask:
+        with self._lock:
+            self._expire_locked()
+            active = sum(1 for t in self._tasks.values()
+                         if t.state is TaskState.ACTIVE)
+            if active >= self.max_active:
+                raise RuntimeError(
+                    f"too many active user tasks ({active} >= {self.max_active})")
+            tid = task_id or str(uuid.uuid4())
+            progress = OperationProgress()
+            fut = self._pool.submit(self._run, operation, progress)
+            task = UserTask(tid, endpoint, query, fut, progress)
+            fut.add_done_callback(
+                lambda f, t=task: setattr(t, "end_ms", time.time() * 1000))
+            self._tasks[tid] = task
+            return task
+
+    @staticmethod
+    def _run(operation, progress):
+        try:
+            return operation(progress)
+        finally:
+            progress.finish()
+
+    def get(self, task_id: str) -> Optional[UserTask]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def get_or_create(self, task_id: Optional[str], endpoint: str, query: str,
+                      operation) -> UserTask:
+        """202-until-done semantics: an existing id returns the SAME task."""
+        if task_id:
+            existing = self.get(task_id)
+            if existing is not None:
+                return existing
+        return self.submit(endpoint, query, operation, task_id=task_id)
+
+    def all_tasks(self) -> List[UserTask]:
+        with self._lock:
+            self._expire_locked()
+            return sorted(self._tasks.values(), key=lambda t: -t.start_ms)
+
+    def _expire_locked(self) -> None:
+        now = time.time() * 1000
+        for tid, t in list(self._tasks.items()):
+            if (t.state is not TaskState.ACTIVE and t.end_ms
+                    and now - t.end_ms > self.retention_ms):
+                del self._tasks[tid]
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,212 @@
+"""Service-layer tests: config system, REST endpoints over a live server,
+async user tasks, two-step verification (models
+KafkaCruiseControlServletEndpointTest / UserTaskManagerTest)."""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.common.exceptions import ConfigError
+from cruise_control_tpu.config.config_def import ConfigDef, ConfigType, load_properties
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.servlet.server import USER_TASK_HEADER, CruiseControlApp
+from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
+from tests.test_facade import build_stack
+
+
+# ------------------------------------------------------------------- config
+
+
+def test_config_defaults_and_coercion():
+    cfg = CruiseControlConfig({"cpu.capacity.threshold": "0.9",
+                               "self.healing.enabled": "true",
+                               "max.replicas.per.broker": "5000"})
+    assert cfg["cpu.capacity.threshold"] == 0.9
+    assert cfg["self.healing.enabled"] is True
+    assert cfg["max.replicas.per.broker"] == 5000
+    assert cfg.goal_names()[0] == "RackAwareGoal"
+
+
+def test_config_accepts_java_class_names():
+    cfg = CruiseControlConfig({
+        "default.goals": "com.linkedin.kafka.cruisecontrol.analyzer.goals."
+                         "RackAwareGoal,com.linkedin.kafka.cruisecontrol."
+                         "analyzer.goals.ReplicaCapacityGoal"})
+    assert cfg.goal_names() == ["RackAwareGoal", "ReplicaCapacityGoal"]
+
+
+def test_config_validates():
+    with pytest.raises(ConfigError):
+        CruiseControlConfig({"cpu.capacity.threshold": "1.5"})
+    with pytest.raises(ConfigError):
+        CruiseControlConfig({"default.goals": "NoSuchGoal"})
+
+
+def test_config_properties_file(tmp_path):
+    p = tmp_path / "cc.properties"
+    p.write_text("# comment\nwebserver.http.port=7777\n"
+                 "disk.balance.threshold=1.3\n")
+    cfg = CruiseControlConfig.from_properties_file(str(p))
+    assert cfg["webserver.http.port"] == 7777
+    assert abs(cfg.balancing_constraint().balance_threshold[3] - 1.3) < 1e-6
+
+
+def test_reference_properties_file_parses():
+    """The reference's shipped cruisecontrol.properties must parse."""
+    props = load_properties("/root/reference/config/cruisecontrol.properties")
+    cfg = CruiseControlConfig(props)
+    assert cfg.goal_names("hard.goals") == [
+        "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+        "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+        "CpuCapacityGoal"]
+
+
+# --------------------------------------------------------------- user tasks
+
+
+def test_user_task_manager_dedup_and_retention():
+    utm = UserTaskManager(num_threads=2, completed_retention_ms=1e9)
+    t1 = utm.submit("rebalance", "dryrun=true", lambda p: 42)
+    t1.future.result()
+    same = utm.get_or_create(t1.task_id, "rebalance", "dryrun=true", lambda p: 43)
+    assert same is t1
+    assert same.future.result() == 42
+    assert t1.state is TaskState.COMPLETED
+
+
+def test_user_task_error_state():
+    utm = UserTaskManager(num_threads=1)
+
+    def boom(progress):
+        raise ValueError("nope")
+
+    t = utm.submit("rebalance", "", boom)
+    with pytest.raises(ValueError):
+        t.future.result()
+    assert t.state is TaskState.COMPLETED_WITH_ERROR
+
+
+# ------------------------------------------------------------------- server
+
+
+@pytest.fixture(scope="module")
+def app():
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    application = CruiseControlApp(cc, port=0)
+    application.start()
+    yield application
+    application.stop()
+
+
+def _get(app, endpoint, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/{endpoint}"
+    if qs:
+        url += f"?{qs}"
+    with urllib.request.urlopen(url) as r:
+        return r.status, json.loads(r.read().decode()), dict(r.headers)
+
+
+def _post(app, endpoint, headers=None, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/{endpoint}"
+    if qs:
+        url += f"?{qs}"
+    req = urllib.request.Request(url, method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def test_state_endpoint(app):
+    status, body, _ = _get(app, "state")
+    assert status == 200
+    assert body["MonitorState"]["numValidWindows"] == 5
+    assert body["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+
+
+def test_load_and_partition_load(app):
+    status, body, _ = _get(app, "load")
+    assert status == 200 and body["numBrokers"] == 4
+    status, body, _ = _get(app, "partition_load", entries=3)
+    assert status == 200 and len(body["records"]) == 3
+
+
+def test_kafka_cluster_state(app):
+    status, body, _ = _get(app, "kafka_cluster_state")
+    assert status == 200
+    assert body["KafkaBrokerState"]["Summary"]["brokers"] == 4
+
+
+def test_rebalance_dryrun_roundtrip(app):
+    status, body, headers = _post(app, "rebalance", dryrun="true",
+                                  goals="ReplicaDistributionGoal")
+    assert status in (200, 202)
+    task_id = headers.get(USER_TASK_HEADER)
+    assert task_id
+    deadline = time.time() + 30
+    while status == 202 and time.time() < deadline:
+        time.sleep(0.1)
+        status, body, headers = _post(app, "rebalance",
+                                      headers={USER_TASK_HEADER: task_id},
+                                      dryrun="true",
+                                      goals="ReplicaDistributionGoal")
+    assert status == 200
+    assert body["dryrun"] is True and body["executed"] is False
+    # The task shows up in user_tasks.
+    _, tasks, _ = _get(app, "user_tasks")
+    assert any(t["UserTaskId"] == task_id for t in tasks["userTasks"])
+
+
+def test_unknown_endpoint_404(app):
+    status, body, _ = _get(app, "state")
+    assert status == 200
+    code, body, _ = _post(app, "nonsense")
+    assert code == 404
+
+
+def test_missing_brokerid_400(app):
+    code, body, _ = _post(app, "remove_broker", dryrun="true")
+    assert code == 400
+
+
+def test_admin_self_healing_toggle(app):
+    code, body, _ = _post(app, "admin", enable_self_healing_for="broker_failure")
+    assert code == 200
+    assert body["selfHealingEnabledBefore"]["BROKER_FAILURE"] in (True, False)
+    _post(app, "admin", disable_self_healing_for="broker_failure")
+
+
+def test_pause_resume_sampling(app):
+    code, body, _ = _post(app, "pause_sampling", reason="test")
+    assert code == 200
+    code, body, _ = _post(app, "resume_sampling", reason="test")
+    assert code == 200
+
+
+def test_two_step_verification_flow():
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    app2 = CruiseControlApp(cc, port=0, two_step_verification=True)
+    app2.start()
+    try:
+        code, body, _ = _post(app2, "rebalance", dryrun="true")
+        assert code == 202 and "reviewResult" in body
+        review_id = body["reviewResult"]["Id"]
+        code, board, _ = _get(app2, "review_board")
+        assert any(r["Id"] == review_id for r in board["RequestInfo"])
+        code, body, _ = _post(app2, "review", approve=str(review_id))
+        assert code == 200
+        code, body, headers = _post(app2, "rebalance", dryrun="true",
+                                    review_id=str(review_id),
+                                    goals="ReplicaDistributionGoal")
+        assert code in (200, 202)
+    finally:
+        app2.stop()
