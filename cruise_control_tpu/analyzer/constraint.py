@@ -56,8 +56,8 @@ class BalancingConstraint:
     # Overprovisioning detection (OptimizerResult provision status).
     overprovisioned_max_replicas_per_broker: int = 1500
     # Solver knobs (no reference equivalent: kernel batch sizing).
-    max_candidates_per_round: int = 1024
-    max_rounds_per_goal: int = 64
+    max_candidates_per_round: int = 4096
+    max_rounds_per_goal: int = 96
 
     def balance_band(self, triggered_by_goal_violation: bool = False) -> np.ndarray:
         t = self.balance_threshold.astype(np.float32)

@@ -46,6 +46,9 @@ class Goal:
     uses_replica_moves: bool = True
     uses_leadership_moves: bool = False
     has_pull_phase: bool = False
+    # True when accept_replica_move depends on the SOURCE broker's state —
+    # the solver then limits batches to one outbound move per source.
+    src_sensitive_accept: bool = False
 
     def key(self) -> str:
         """Jit-cache key; goals with numeric config should include it here."""

@@ -38,6 +38,7 @@ class ReplicaDistributionGoal(Goal):
     name = "ReplicaDistributionGoal"
     is_hard = False
     has_pull_phase = True
+    src_sensitive_accept = True
 
     def _counts(self, gctx, agg):
         return agg.replica_counts
@@ -182,6 +183,7 @@ class TopicReplicaDistributionGoal(Goal):
 
     name = "TopicReplicaDistributionGoal"
     is_hard = False
+    src_sensitive_accept = True
 
     def _bounds(self, gctx, agg):
         """(upper i32[T], lower i32[T]) per-topic count bands."""

@@ -40,6 +40,7 @@ class ResourceDistributionGoal(Goal):
 
     is_hard = False
     has_pull_phase = True
+    src_sensitive_accept = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):

@@ -76,6 +76,7 @@ class MinTopicLeadersPerBrokerGoal(Goal):
 
     name = "MinTopicLeadersPerBrokerGoal"
     is_hard = True
+    src_sensitive_accept = True
     uses_replica_moves = False
     uses_leadership_moves = True
 

@@ -122,8 +122,8 @@ class GoalOptimizer:
         self.goal_names = list(goal_names or DEFAULT_GOALS)
         if solver is not None:
             self.solver = solver
-        elif (self.constraint.max_candidates_per_round == 1024
-              and self.constraint.max_rounds_per_goal == 64):
+        elif (self.constraint.max_candidates_per_round == 4096
+              and self.constraint.max_rounds_per_goal == 96):
             self.solver = default_solver()
         else:
             self.solver = GoalSolver(

@@ -4,43 +4,44 @@ Replaces the reference's per-goal greedy search (``AbstractGoal.optimize``
 :78-130 — ``while !finished: for broker: rebalanceForBroker`` with every
 candidate action re-checked against all previously-optimized goals at
 ``AbstractGoal.maybeApplyBalancingAction`` :214-256).  The TPU formulation
-batches the heavy part and keeps the sequential part cheap:
+makes every round one fused batch, with no sequential scan at all:
 
 round (one jitted call per goal class)
- 1. score all R replicas; ``lax.top_k`` picks ≤C candidates        (O(R))
- 2. build the C×B feasibility mask: structural legitMove ∧ this
-    goal's self-condition ∧ every prior goal's actionAcceptance    (O(C·B))
- 3. per-candidate best destination by goal cost ``argmin``         (O(C·B))
- 4. ``lax.scan`` over candidates in priority order: re-check the
-    chosen move against the *updated* aggregates (the same predicate
-    functions, now scalar) and apply it with O(1) scatter updates   (O(C))
+ 1. score all R replicas; ``lax.top_k`` picks ≤C candidates           (O(R))
+ 2. build the C×B feasibility mask: structural legitMove ∧ this goal's
+    self-condition ∧ every prior goal's actionAcceptance               (O(C·B))
+ 3. per-candidate best destination by goal cost ``argmin``             (O(C·B))
+ 4. conflict-free selection: keep at most one move per source broker,
+    destination broker, destination host and partition (segment-min over
+    the priority order)                                                (O(C))
+ 5. apply ALL kept moves with one masked scatter + full aggregate
+    recompute (segment-sums)                                           (O(R))
 
-Rounds repeat from the host until no move applies or the goal reports no
-violated broker.  Sequential-greedy fidelity therefore holds at candidate
-granularity — every applied move was valid at apply time, exactly like the
-reference's immediate-mutation loop — while all O(R·B) scoring runs as one
-fused XLA program per round.
+Why step 4 makes batching safe: every predicate in step 2 was evaluated
+against the round-start state; restricting the batch to one move per
+source/destination/host/partition means no kept move can invalidate another
+kept move's capacity, count-band, balance-band or rack check — so every
+applied move satisfies exactly what the reference's immediate-mutation loop
+would have checked.  Load conservation keeps balance-band thresholds fixed
+within a round.  Anything skipped by conflict resolution is simply picked up
+next round against fresh aggregates.
 """
 
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from cruise_control_tpu.analyzer.context import (
     Aggregates,
     GoalContext,
-    apply_intra_disk_move,
-    apply_leadership_move,
-    apply_replica_move,
     base_leadership_ok,
     base_replica_move_ok,
     compute_aggregates,
+    current_leader_of,
     currently_offline,
 )
 from cruise_control_tpu.analyzer.goals.base import Goal
@@ -91,20 +92,66 @@ def _pick_dst_disk(gctx: GoalContext, agg: Aggregates, dst):
     """Emptiest alive logdir of dst (disk chosen at move-apply time)."""
     frac = agg.disk_load[dst] / jnp.maximum(gctx.state.disk_capacity[dst], 1e-9)
     frac = jnp.where(gctx.state.disk_alive[dst], frac, jnp.inf)
-    return jnp.argmin(frac, axis=-1)
+    return jnp.argmin(frac, axis=-1).astype(jnp.int32)
+
+
+def _group_winners(order_key: jnp.ndarray, group: jnp.ndarray,
+                   num_groups: int) -> jnp.ndarray:
+    """bool[C]: is this candidate the best (smallest order_key) in its group.
+
+    order_key carries C (out of range) for non-candidates so they never win.
+    """
+    best = jax.ops.segment_min(order_key, group, num_segments=num_groups)
+    return best[group] == order_key
+
+
+def _hash01(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic pseudo-uniform [0,1) from two index arrays (broadcast).
+
+    Destination tie-breaker: without it every candidate's argmin lands on the
+    single emptiest broker and the one-move-per-destination rule collapses
+    the batch to one move per round.
+    """
+    x = jnp.sin(a.astype(jnp.float32) * 12.9898 + b.astype(jnp.float32) * 78.233)
+    v = x * 43758.5453
+    return v - jnp.floor(v)
+
+
+def _jittered(cost: jnp.ndarray, ok: jnp.ndarray, cand: jnp.ndarray,
+              d2: jnp.ndarray, frac: float = 1.0) -> jnp.ndarray:
+    """Add per-(candidate, dst) jitter scaled to each candidate's feasible
+    cost range so the batch spreads over every acceptable destination instead
+    of piling onto the single argmin (the feasibility mask already bounds
+    quality: every candidate destination satisfies self_ok + acceptance)."""
+    lo = jnp.min(jnp.where(ok, cost, jnp.inf), axis=1, keepdims=True)
+    hi = jnp.max(jnp.where(ok, cost, -jnp.inf), axis=1, keepdims=True)
+    span = jnp.where(hi > lo, hi - lo, 0.0)
+    scale = frac * span + 1e-6
+    return cost + _hash01(cand[:, None], d2) * scale
+
+
+def _src_sensitive(goal: Goal, priors: Sequence[Goal]) -> bool:
+    """Does any acceptance predicate in play depend on the SOURCE broker's
+    state?  If not, multiple moves may leave one source in a single batch
+    (hard goals only shed load from sources, so their checks stay valid)."""
+    return any(getattr(g, "src_sensitive_accept", False)
+               for g in (goal, *priors))
 
 
 def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                    score_fn: Callable, self_ok_fn: Callable,
                    dst_mask_fn: Optional[Callable] = None):
-    """Build one replica-move phase function (gctx, placement, agg) ->
-    (placement, agg, applied)."""
+    """One conflict-free batched replica-move phase:
+    (gctx, placement, agg) -> (placement, agg, applied)."""
     accept = _chain_accept_replica(priors)
+    need_src_cap = _src_sensitive(goal, priors)
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
-        b = gctx.state.num_brokers_padded
+        state = gctx.state
+        b = state.num_brokers_padded
+        c = num_candidates
         score = score_fn(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, num_candidates)
+        top_score, cand = jax.lax.top_k(score, c)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
@@ -113,32 +160,44 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         ok = ok & self_ok_fn(gctx, placement, agg, r2, d2)
         if dst_mask_fn is not None:
             ok = ok & dst_mask_fn(gctx, placement, agg)[None, :]
-        cost = jnp.where(ok, goal.dst_cost(gctx, placement, agg, r2, d2), _INF_COST)
-        best_dst = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        cost_raw = goal.dst_cost(gctx, placement, agg, r2, d2)
+        cost = jnp.where(ok, cost_raw, _INF_COST)
+        # Rank matching: the i-th candidate (priority order) gets the i-th
+        # cheapest destination — distinct destinations by construction, so a
+        # batch fills as many brokers as it has candidates instead of every
+        # argmin landing on the single emptiest broker.  Infeasible pairs
+        # fall back to the candidate's own jittered argmin.
+        proxy = jnp.min(cost, axis=0)                        # f32[B]
+        ranked = jnp.argsort(proxy).astype(jnp.int32)        # cheap → expensive
+        assign = ranked[jnp.arange(c, dtype=jnp.int32) % b]
+        ok_assign = jnp.take_along_axis(ok, assign[:, None], axis=1)[:, 0]
+        jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2), _INF_COST)
+        fallback = jnp.argmin(jcost, axis=1).astype(jnp.int32)
+        dst = jnp.where(ok_assign, assign, fallback)
         feasible = jnp.any(ok, axis=1) & is_cand
 
-        def step(carry, i):
-            placement, agg, n = carry
-            r = cand[i]
-            d = best_dst[i]
-            ok_now = (feasible[i]
-                      & accept(gctx, placement, agg, r, d)
-                      & self_ok_fn(gctx, placement, agg, r, d))
-            if dst_mask_fn is not None:
-                # dst-mask is a round-level target set; no re-check needed
-                # beyond the predicates (they see updated aggregates).
-                pass
+        # Conflict-free batch: winners per dst broker / dst host / partition
+        # (and per src broker when any acceptance is source-sensitive), in
+        # candidate-priority order.
+        order = jnp.where(feasible, jnp.arange(c, dtype=jnp.int32), c)
+        part = state.partition[cand]
+        host = state.host[dst]
+        keep = (feasible
+                & _group_winners(order, dst, b)
+                & _group_winners(order, host, gctx.num_hosts)
+                & _group_winners(order, part, gctx.num_partitions))
+        if need_src_cap:
+            keep = keep & _group_winners(order, placement.broker[cand], b)
 
-            def do(args):
-                pl, ag = args
-                return apply_replica_move(gctx, pl, ag, r, d,
-                                          _pick_dst_disk(gctx, ag, d))
-
-            placement, agg = jax.lax.cond(ok_now, do, lambda a: a, (placement, agg))
-            return (placement, agg, n + ok_now.astype(jnp.int32)), None
-
-        (placement, agg, applied), _ = jax.lax.scan(
-            step, (placement, agg, jnp.int32(0)), jnp.arange(num_candidates))
+        dst_disk = _pick_dst_disk(gctx, agg, dst)
+        new_broker = jnp.where(keep, dst, placement.broker[cand])
+        new_disk = jnp.where(keep, dst_disk, placement.disk[cand])
+        placement = placement.replace(
+            broker=placement.broker.at[cand].set(new_broker),
+            disk=placement.disk.at[cand].set(new_disk),
+        )
+        applied = jnp.sum(keep.astype(jnp.int32))
+        agg = compute_aggregates(gctx, placement)
         return placement, agg, applied
 
     return phase
@@ -148,26 +207,36 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     accept = _chain_accept_leadership(priors)
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+        state = gctx.state
+        c = num_candidates
         score = goal.leadership_candidate_score(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, num_candidates)
+        top_score, cand = jax.lax.top_k(score, c)
         is_cand = top_score > _SCORE_FLOOR
 
-        def step(carry, i):
-            placement, agg, n = carry
-            f = cand[i]
-            ok_now = (is_cand[i]
-                      & accept(gctx, placement, agg, f)
-                      & goal.leadership_self_ok(gctx, placement, agg, f))
+        ok = (is_cand & accept(gctx, placement, agg, cand)
+              & goal.leadership_self_ok(gctx, placement, agg, cand))
+        old = current_leader_of(gctx, placement, state.partition[cand])  # i32[C]
+        ok = ok & (old >= 0)
+        old_safe = jnp.maximum(old, 0)
 
-            def do(args):
-                pl, ag = args
-                return apply_leadership_move(gctx, pl, ag, f)
+        # One promotion per partition, per gaining broker, per losing broker.
+        order = jnp.where(ok, jnp.arange(c, dtype=jnp.int32), c)
+        gain_b = placement.broker[cand]
+        lose_b = placement.broker[old_safe]
+        b = state.num_brokers_padded
+        keep = (ok
+                & _group_winners(order, state.partition[cand], gctx.num_partitions)
+                & _group_winners(order, gain_b, b)
+                & _group_winners(order, lose_b, b))
 
-            placement, agg = jax.lax.cond(ok_now, do, lambda a: a, (placement, agg))
-            return (placement, agg, n + ok_now.astype(jnp.int32)), None
-
-        (placement, agg, applied), _ = jax.lax.scan(
-            step, (placement, agg, jnp.int32(0)), jnp.arange(num_candidates))
+        is_leader = placement.is_leader
+        is_leader = is_leader.at[cand].set(
+            jnp.where(keep, True, is_leader[cand]))
+        is_leader = is_leader.at[old_safe].set(
+            jnp.where(keep, False, is_leader[old_safe]))
+        placement = placement.replace(is_leader=is_leader)
+        applied = jnp.sum(keep.astype(jnp.int32))
+        agg = compute_aggregates(gctx, placement)
         return placement, agg, applied
 
     return phase
@@ -175,36 +244,37 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
 
 def _intra_disk_phase(goal: Goal, num_candidates: int):
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
-        d_n = gctx.state.num_disks_per_broker
+        state = gctx.state
+        d_n = state.num_disks_per_broker
+        c = num_candidates
         score = goal.disk_candidate_score(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, num_candidates)
+        top_score, cand = jax.lax.top_k(score, c)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
         d2 = jnp.arange(d_n)[None, :]
         ok = goal.disk_move_ok(gctx, placement, agg, r2, d2)
-        b2 = placement.broker[r2]
-        frac = ((agg.disk_load[b2, d2] + gctx.state.leader_load[r2, 3])
-                / jnp.maximum(gctx.state.disk_capacity[b2, d2], 1e-9))
+        b2 = placement.broker[cand][:, None]
+        frac = ((agg.disk_load[b2, d2] + state.leader_load[r2, 3])
+                / jnp.maximum(state.disk_capacity[b2, d2], 1e-9))
         cost = jnp.where(ok, frac, _INF_COST)
         best = jnp.argmin(cost, axis=1).astype(jnp.int32)
         feasible = jnp.any(ok, axis=1) & is_cand
 
-        def step(carry, i):
-            placement, agg, n = carry
-            r = cand[i]
-            d = best[i]
-            ok_now = feasible[i] & goal.disk_move_ok(gctx, placement, agg, r, d)
+        # One move per source logdir and per destination logdir.
+        b_of = placement.broker[cand]
+        src_key = b_of * d_n + placement.disk[cand]
+        dst_key = b_of * d_n + best
+        order = jnp.where(feasible, jnp.arange(c, dtype=jnp.int32), c)
+        nseg = state.num_brokers_padded * d_n
+        keep = (feasible
+                & _group_winners(order, src_key, nseg)
+                & _group_winners(order, dst_key, nseg))
 
-            def do(args):
-                pl, ag = args
-                return apply_intra_disk_move(gctx, pl, ag, r, d)
-
-            placement, agg = jax.lax.cond(ok_now, do, lambda a: a, (placement, agg))
-            return (placement, agg, n + ok_now.astype(jnp.int32)), None
-
-        (placement, agg, applied), _ = jax.lax.scan(
-            step, (placement, agg, jnp.int32(0)), jnp.arange(num_candidates))
+        new_disk = jnp.where(keep, best, placement.disk[cand])
+        placement = placement.replace(disk=placement.disk.at[cand].set(new_disk))
+        applied = jnp.sum(keep.astype(jnp.int32))
+        agg = compute_aggregates(gctx, placement)
         return placement, agg, applied
 
     return phase
@@ -214,8 +284,8 @@ class GoalSolver:
     """Owns the per-goal jitted round functions; reused across optimizations
     with identical shapes (jit caches on (goal key, priors key, shapes))."""
 
-    def __init__(self, max_candidates_per_round: int = 1024,
-                 max_rounds_per_goal: int = 64):
+    def __init__(self, max_candidates_per_round: int = 4096,
+                 max_rounds_per_goal: int = 96):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self._round_cache = {}
@@ -275,7 +345,11 @@ class GoalSolver:
         info.metric_before = float(goal.stats_metric(gctx, placement, agg0))
 
         violated = info.violated_brokers_before
-        stranded = 1  # force at least one round when offline replicas exist
+        stranded = int(jnp.sum(currently_offline(gctx, placement)))
+        if violated == 0 and stranded == 0:
+            # Nothing to do — don't pay for a full scoring round.
+            info.metric_after = info.metric_before
+            return placement, info
         for _ in range(self.max_rounds):
             if violated == 0 and stranded == 0 and info.rounds > 0:
                 break
