@@ -32,8 +32,8 @@ class PreferredLeaderElectionGoal(Goal):
     is_direct = True
     uses_replica_moves = False
 
-    def direct_apply(self, gctx: GoalContext, placement: Placement,
-                     agg: Aggregates) -> Placement:
+    def _preferred(self, gctx: GoalContext, placement: Placement):
+        """Per partition: (chosen replica row, any eligible?, real partition?)."""
         state = gctx.state
         sibs = gctx.partition_replicas                       # [P, RF]
         safe = jnp.maximum(sibs, 0)
@@ -46,18 +46,33 @@ class PreferredLeaderElectionGoal(Goal):
         choice_slot = jnp.argmin(key, axis=-1)               # [P]
         any_ok = jnp.any(eligible, axis=-1)
         chosen = jnp.take_along_axis(safe, choice_slot[:, None], axis=1)[:, 0]
+        real_p = jnp.any(sibs >= 0, axis=-1)
+        return chosen, any_ok, real_p
+
+    def direct_apply(self, gctx: GoalContext, placement: Placement,
+                     agg: Aggregates) -> Placement:
+        chosen, any_ok, real_p = self._preferred(gctx, placement)
 
         # Keep the current leader where no replica is eligible.
         cur_leader = _current_leaders(gctx, placement)        # i32[P]
         final = jnp.where(any_ok, chosen, jnp.maximum(cur_leader, 0))
         has_any = any_ok | (cur_leader >= 0)
         # Padded partitions (all sibs -1) map to replica 0 — mask them out.
-        real_p = jnp.any(sibs >= 0, axis=-1)
         is_leader = jnp.zeros_like(placement.is_leader).at[final].max(has_any & real_p)
         return placement.replace(is_leader=is_leader)
 
     def violated_brokers(self, gctx, placement, agg):
-        return jnp.zeros(gctx.state.num_brokers_padded, dtype=bool)
+        """A broker is violated while it leads a partition whose preferred
+        (lowest-position eligible) replica lives elsewhere — meaningful so the
+        solver's nothing-to-do early exit and convergence check both work
+        (round-1 regression: constant-False made direct_apply unreachable)."""
+        chosen, any_ok, real_p = self._preferred(gctx, placement)
+        cur = _current_leaders(gctx, placement)               # i32[P]
+        wrong = real_p & any_ok & (chosen != cur)             # covers cur == -1
+        holder = jnp.where(cur >= 0, placement.broker[jnp.maximum(cur, 0)],
+                           placement.broker[chosen])
+        out = jnp.zeros(gctx.state.num_brokers_padded, dtype=bool)
+        return out.at[holder].max(wrong)
 
 
 def _current_leaders(gctx: GoalContext, placement: Placement) -> jnp.ndarray:

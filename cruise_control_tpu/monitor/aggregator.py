@@ -112,6 +112,7 @@ class MetricSampleAggregator:
         self._entity_index: Dict[Hashable, int] = {}
         self._entities: List[Hashable] = []
         self._current_window = -1
+        self._first_window = -1
         self._generation = 0
         strat = metric_def.strategy_vector()
         self._avg_mask = strat == 0
@@ -144,6 +145,7 @@ class MetricSampleAggregator:
         """Advance the active window, clearing reused ring slots."""
         if self._current_window < 0:
             self._current_window = window
+            self._first_window = window
             self._slot_window[window % self._slots] = window
             return
         if window - self._current_window >= self._slots:
@@ -178,6 +180,7 @@ class MetricSampleAggregator:
         """
         with self._lock:
             windows = (np.asarray(times_ms, dtype=np.int64) // self.window_ms)
+            first_ingest = self._current_window < 0
             newest = int(windows.max(initial=self._current_window))
             if newest > self._current_window:
                 self._roll_to(newest)
@@ -185,6 +188,14 @@ class MetricSampleAggregator:
             ok = windows >= max(oldest_kept, 0)
             if not ok.any():
                 return 0
+            # Track the oldest window that ever ACCEPTED a sample: backfill
+            # within the retained ring (windows older than the batch that
+            # created the ring) must widen the observed range, and a batched
+            # first ingest must count from its oldest window, not the newest
+            # one _roll_to saw.
+            accepted_oldest = int(windows[ok].min())
+            if first_ingest or accepted_oldest < self._first_window:
+                self._first_window = max(accepted_oldest, 0)
             idx = np.fromiter((self._ensure_entity(e) for e in entities),
                               dtype=np.int64, count=len(entities))[ok]
             slots = (windows % self._slots)[ok]
@@ -224,7 +235,11 @@ class MetricSampleAggregator:
         lo = 0 if from_ms == -np.inf else int(from_ms // self.window_ms)
         hi = (self._current_window if to_ms == np.inf
               else int(to_ms // self.window_ms))
-        oldest = max(self._current_window - self.num_windows, 0)
+        # Clamp to the first-observed window: with absolute epoch window
+        # indices the ring "positions" before the first sample never existed,
+        # so they must not count as (trivially-valid) completed windows.
+        oldest = max(self._current_window - self.num_windows,
+                     self._first_window, 0)
         start = max(lo, oldest)
         end = min(hi, self._current_window - 1)
         return list(range(start, end + 1))
@@ -350,10 +365,13 @@ class MetricSampleAggregator:
             return list(self._entities)
 
     def num_available_windows(self) -> int:
+        """Completed windows observed since the first sample (the window index
+        is absolute ``time_ms // window_ms``, so count from the first-observed
+        window, not from zero)."""
         with self._lock:
             if self._current_window < 0:
                 return 0
-            return min(self.num_windows, self._current_window)
+            return min(self.num_windows, self._current_window - self._first_window)
 
     def retain_entities(self, keep) -> None:
         """Drop entities not in ``keep`` (topology change cleanup)."""

@@ -203,10 +203,12 @@ class LoadMonitor:
             # (Load.expectedUtilizationFor :84-98 over the window axis).
             per_metric = vae.values.mean(axis=1)       # f32[M]
             load = mat @ per_metric                    # f32[4]
-            leader_broker = p.leader if p.leader in broker_info else p.replicas[0]
-            if any(r.broker_id == leader_broker
-                   for r in cm.partition(p.topic, p.partition)):
-                cm.set_replica_load(p.topic, p.partition, leader_broker, load)
+            # Every replica gets the aggregated leader metrics (reference:
+            # MonitorUtils.populatePartitionLoad :382-447 sets load per
+            # replica); the two-role model derives the follower-role load via
+            # effective_follower_load(), so followers are NOT zero.
+            for r in cm.partition(p.topic, p.partition):
+                cm.set_replica_load(p.topic, p.partition, r.broker_id, load)
         # Dead brokers last so offline flags land on populated replicas.
         for b in metadata.brokers:
             if not b.alive:

@@ -13,6 +13,7 @@ hermetic; the layering (app → façade → components) mirrors
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 import threading
@@ -215,7 +216,10 @@ class CruiseControlApp:
             try:
                 result = task.future.result(timeout=5.0)
                 return 200, self._render(result), headers
-            except TimeoutError:
+            except concurrent.futures.TimeoutError:
+                # On 3.11+ this is the builtin TimeoutError; on 3.10 it is a
+                # distinct class, and catching only the builtin returned 500
+                # instead of the 202-in-progress contract.
                 return 202, {"progress": task.progress.to_list(),
                              "message": "operation in progress"}, headers
             except CruiseControlError as e:

@@ -238,3 +238,53 @@ def test_metadata_generation_tracks_changes():
     backend.kill_broker(1)
     client.refresh_metadata()
     assert client.generation == g0 + 1
+
+
+def test_follower_replicas_have_load():
+    """ADVICE r1: _populate must set load on every replica, so a follower-only
+    broker shows non-zero utilization (MonitorUtils.populatePartitionLoad)."""
+    backend = _fake_cluster()
+    lm, runner = _monitored(backend)
+    runner.bootstrap(0, 6 * W)
+    state, placement, meta = lm.cluster_model(0, 6 * W)
+    from cruise_control_tpu.model import ops
+    bl = np.asarray(ops.broker_load(state, placement))[:meta.num_brokers]
+    # Every broker hosts at least one replica in _fake_cluster; all must show
+    # non-zero disk (col 3) load — follower-role load derives from leader load.
+    assert (bl[:, 3] > 0).all()
+    fol = np.asarray(state.follower_load)[:meta.num_replicas]
+    assert fol[:, 3].sum() > 0
+
+
+def test_num_available_windows_epoch_timestamps():
+    """ADVICE r1: with absolute epoch-ms first samples, available windows must
+    count from the first-observed window, not from window index 0."""
+    agg = _agg()
+    e = ("t", 0)
+    base = 1_700_000  # epoch-like: window index base/W >> num_windows
+    fill(agg, e, [base // W])
+    assert agg.num_available_windows() == 0      # only the active window so far
+    fill(agg, e, [base // W + 1])
+    assert agg.num_available_windows() == 1
+
+
+def test_first_batch_ingest_counts_all_windows():
+    """A batched first ingest spanning several windows must count its oldest
+    accepted window as first-observed, and completeness must not report
+    windows that predate the first sample."""
+    agg = _agg()
+    e = ("t", 0)
+    base = 1_700_000 // W
+    fill(agg, e, [base + i for i in range(5)])    # one batched bootstrap
+    fill(agg, e, [base + 5], per_window=1)        # active window
+    assert agg.num_available_windows() == 5
+    res = agg.aggregate(-np.inf, np.inf)
+    assert res.completeness.valid_windows == [base + i for i in range(5)]
+
+
+def test_completeness_empty_before_first_completed_window():
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [1_700_000 // W], per_window=1)  # single active window only
+    comp = agg.completeness(-np.inf, np.inf)
+    assert comp.valid_windows == []
