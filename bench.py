@@ -18,6 +18,22 @@ import time
 
 NORTH_STAR_BUDGET_S = 10.0
 
+
+def select_backend() -> str:
+    """Pick the JAX backend BEFORE the first in-process jax import.
+
+    The axon TPU backend rides a tunnel that can be down or version-skewed,
+    and its init can hang or raise — either would turn the whole bench into
+    rc≠0.  Probe it in a throwaway subprocess with a timeout; on any failure
+    force the CPU platform (and deregister the axon PJRT factory) so the
+    bench always produces a number, annotated with the backend it ran on.
+    """
+    from cruise_control_tpu.utils.hermetic import force_cpu, probe_tpu
+    if probe_tpu():
+        return "tpu"
+    force_cpu()
+    return "cpu"
+
 GOALS = [
     "RackAwareGoal",
     "ReplicaCapacityGoal",
@@ -35,6 +51,8 @@ GOALS = [
 
 
 def main() -> None:
+    backend = select_backend()
+
     from cruise_control_tpu.analyzer import BalancingConstraint, GoalOptimizer
     from cruise_control_tpu.testing import random_cluster as rc
 
@@ -59,6 +77,7 @@ def main() -> None:
         "value": round(elapsed, 4),
         "unit": "seconds",
         "vs_baseline": round(NORTH_STAR_BUDGET_S / max(elapsed, 1e-9), 3),
+        "backend": backend,
     }))
 
 

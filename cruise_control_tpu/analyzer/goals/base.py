@@ -46,6 +46,7 @@ class Goal:
     uses_replica_moves: bool = True
     uses_leadership_moves: bool = False
     has_pull_phase: bool = False
+    has_swap_phase: bool = False
     # True when accept_replica_move depends on the SOURCE broker's state —
     # the solver then limits batches to one outbound move per source.
     src_sensitive_accept: bool = False
@@ -122,6 +123,46 @@ class Goal:
                                agg: Aggregates, f):
         """actionAcceptance for later goals' leadership promotions."""
         return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+
+    # ----------------------------------------------------------------- swap
+    # The reference's third rebalancing mechanism
+    # (ResourceDistributionGoal.java:543-725 rebalanceBySwappingLoadOut/In):
+    # exchange a heavy replica on a loaded broker with a light replica on a
+    # less-loaded one, transferring the load *difference* without changing
+    # replica counts — the only mechanism that works when no broker has
+    # one-way headroom.  Batched form: top-k out-candidates × top-k
+    # in-candidates, a C×C pair-feasibility matrix, conflict-free selection.
+
+    def swap_out_score(self, gctx: GoalContext, placement: Placement,
+                       agg: Aggregates) -> jnp.ndarray:
+        """f32[R]: -inf = not a swap-out candidate; higher = try first."""
+        return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
+
+    def swap_in_score(self, gctx: GoalContext, placement: Placement,
+                      agg: Aggregates) -> jnp.ndarray:
+        """f32[R]: -inf = not a swap-in candidate; higher = try first."""
+        return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
+
+    def swap_ok(self, gctx: GoalContext, placement: Placement, agg: Aggregates,
+                r_out, r_in):
+        """Would swapping r_out ↔ r_in satisfy/improve THIS goal (pairwise)."""
+        return jnp.broadcast_to(jnp.asarray(False), jnp.broadcast_shapes(
+            jnp.shape(r_out), jnp.shape(r_in)))
+
+    def swap_cost(self, gctx: GoalContext, placement: Placement, agg: Aggregates,
+                  r_out, r_in):
+        """Lower = preferred pair (default: residual imbalance after swap)."""
+        return jnp.zeros(jnp.broadcast_shapes(jnp.shape(r_out), jnp.shape(r_in)),
+                         dtype=jnp.float32)
+
+    def accept_swap(self, gctx: GoalContext, placement: Placement,
+                    agg: Aggregates, r_out, r_in, b_out, b_in):
+        """actionAcceptance for later goals' SWAP actions.  Default: accept
+        iff both directional moves are individually acceptable (conservative —
+        each direction is checked against pre-swap aggregates, so the vacated
+        headroom is not credited)."""
+        return (self.accept_replica_move(gctx, placement, agg, r_out, b_in)
+                & self.accept_replica_move(gctx, placement, agg, r_in, b_out))
 
     # ------------------------------------------------------ pull (move-in)
 

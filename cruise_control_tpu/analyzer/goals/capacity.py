@@ -86,6 +86,27 @@ class CapacityGoal(Goal):
         after = agg.broker_load[dst, res] + load
         return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
 
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Exact: only the load DELTA lands on each end (the directional
+        default would double-count and veto swaps near the cap)."""
+        res = self.resource
+        delta = (replica_role_load(gctx, placement, r_out)[..., res]
+                 - replica_role_load(gctx, placement, r_in)[..., res])
+        b_ok = ((agg.broker_load[b_in, res] + delta <= self._limit(gctx, b_in))
+                | (delta <= 0))
+        b_ok = b_ok & ((agg.broker_load[b_out, res] - delta
+                        <= self._limit(gctx, b_out)) | (delta >= 0))
+        if not IS_HOST_RESOURCE[res]:
+            return b_ok
+        h_in = gctx.state.host[b_in]
+        h_out = gctx.state.host[b_out]
+        same = h_in == h_out
+        h_ok_in = ((agg.host_load[h_in, res] + delta <= self._host_limit(gctx, h_in))
+                   | (delta <= 0))
+        h_ok_out = ((agg.host_load[h_out, res] - delta <= self._host_limit(gctx, h_out))
+                    | (delta >= 0))
+        return b_ok & (same | (h_ok_in & h_ok_out))
+
     def stats_metric(self, gctx, placement, agg):
         """Total over-limit load (lower better, 0 == satisfied)."""
         res = self.resource
@@ -143,6 +164,11 @@ class ReplicaCapacityGoal(Goal):
     def accept_replica_move(self, gctx, placement, agg, r, dst):
         del r
         return agg.replica_counts[dst] + 1 <= gctx.max_replicas_per_broker
+
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Swaps are count-neutral."""
+        return jnp.broadcast_to(jnp.asarray(True), jnp.broadcast_shapes(
+            jnp.shape(r_out), jnp.shape(r_in)))
 
     def dst_cost(self, gctx, placement, agg, r, dst):
         del r

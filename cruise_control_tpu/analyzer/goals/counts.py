@@ -93,6 +93,11 @@ class ReplicaDistributionGoal(Goal):
         del r
         return self._counts(gctx, agg)[dst].astype(jnp.float32)
 
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """A swap is count-neutral on both brokers — always acceptable."""
+        return jnp.broadcast_to(jnp.asarray(True), jnp.broadcast_shapes(
+            jnp.shape(r_out), jnp.shape(r_in)))
+
     def pull_dst_mask(self, gctx, placement, agg):
         _, lower = self._bounds(gctx, agg)
         return (self._counts(gctx, agg) < lower) & alive_mask(gctx)
@@ -174,6 +179,24 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         b = placement.broker[jnp.asarray(f)]
         return c[b] + 1 <= upper
 
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Leader counts shift only when the swapped replicas' roles differ:
+        b_in nets is_leader(r_out) - is_leader(r_in).  Only the gaining end is
+        held to the upper bound and the losing end to the lower bound, and a
+        move in the improving direction on an already-violated broker is
+        never vetoed (matches the was_over escape in the other acceptances)."""
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
+        d = (placement.is_leader[jnp.asarray(r_out)].astype(jnp.int32)
+             - placement.is_leader[jnp.asarray(r_in)].astype(jnp.int32))
+        in_after = c[b_in] + d
+        out_after = c[b_out] - d
+        gain_ok = (in_after <= upper) | (d <= 0)      # b_in gains when d > 0
+        lose_ok = (out_after >= lower) | (d <= 0)     # b_out loses when d > 0
+        gain_ok2 = (out_after <= upper) | (d >= 0)    # b_out gains when d < 0
+        lose_ok2 = (in_after >= lower) | (d >= 0)     # b_in loses when d < 0
+        return gain_ok & lose_ok & gain_ok2 & lose_ok2
+
     def stats_metric(self, gctx, placement, agg):
         return super().stats_metric(gctx, placement, agg)
 
@@ -230,6 +253,19 @@ class TopicReplicaDistributionGoal(Goal):
     def dst_cost(self, gctx, placement, agg, r, dst):
         t = gctx.state.topic[jnp.asarray(r)]
         return agg.topic_counts[t, dst].astype(jnp.float32)
+
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Same-topic swaps are neutral; cross-topic swaps move one count of
+        each topic in opposite directions."""
+        upper, lower = self._bounds(gctx, agg)
+        t_out = gctx.state.topic[jnp.asarray(r_out)]
+        t_in = gctx.state.topic[jnp.asarray(r_in)]
+        same = t_out == t_in
+        in_gain_ok = agg.topic_counts[t_out, b_in] + 1 <= upper[t_out]
+        in_lose_ok = agg.topic_counts[t_in, b_in] - 1 >= lower[t_in]
+        out_gain_ok = agg.topic_counts[t_in, b_out] + 1 <= upper[t_in]
+        out_lose_ok = agg.topic_counts[t_out, b_out] - 1 >= lower[t_out]
+        return same | (in_gain_ok & in_lose_ok & out_gain_ok & out_lose_ok)
 
     def stats_metric(self, gctx, placement, agg):
         upper, lower = self._bounds(gctx, agg)

@@ -40,6 +40,7 @@ class ResourceDistributionGoal(Goal):
 
     is_hard = False
     has_pull_phase = True
+    has_swap_phase = True
     src_sensitive_accept = True
     resource: int = Resource.DISK
 
@@ -129,6 +130,90 @@ class ResourceDistributionGoal(Goal):
         load = replica_role_load(gctx, placement, r)[..., res]
         after = agg.broker_load[dst, res] + load
         return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
+
+    # ------------------------------------------------------------ swap phase
+    # ResourceDistributionGoal.java:543-725: when no broker has one-way
+    # headroom, exchange a heavy replica on an over/above-average broker with
+    # a lighter one on an under/below-average broker — only the load DELTA
+    # transfers, so bands that reject any full replica move can still accept
+    # a swap.
+
+    def _swap_base_mask(self, gctx, placement):
+        state = gctx.state
+        return (state.valid & ~gctx.replica_excluded
+                & ~currently_offline(gctx, placement))
+
+    def swap_out_score(self, gctx, placement, agg):
+        """Heavy replicas on above-average brokers, heaviest first."""
+        res = self.resource
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        hot = (agg.broker_load[:, res]
+               > avg * gctx.state.capacity[:, res]) & alive_mask(gctx)
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = hot[placement.broker] & self._swap_base_mask(gctx, placement)
+        return jnp.where(cand, prio, NEG_INF)
+
+    def swap_in_score(self, gctx, placement, agg):
+        """Light replicas on below-average brokers, lightest first."""
+        res = self.resource
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        cold = (agg.broker_load[:, res]
+                < avg * gctx.state.capacity[:, res]) & alive_mask(gctx)
+        prio = self.replica_priority(gctx, placement, agg)
+        cand = cold[placement.broker] & self._swap_base_mask(gctx, placement)
+        return jnp.where(cand, -prio, NEG_INF)
+
+    def _swap_after(self, gctx, placement, agg, r_out, r_in):
+        """(delta, b_out, b_in, load-after both sides) for the pair tile."""
+        res = self.resource
+        lo = replica_role_load(gctx, placement, r_out)[..., res]
+        li = replica_role_load(gctx, placement, r_in)[..., res]
+        delta = lo - li
+        b_out = placement.broker[jnp.asarray(r_out)]
+        b_in = placement.broker[jnp.asarray(r_in)]
+        out_after = agg.broker_load[b_out, res] - delta
+        in_after = agg.broker_load[b_in, res] + delta
+        return delta, b_out, b_in, out_after, in_after
+
+    def swap_ok(self, gctx, placement, agg, r_out, r_in):
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        delta, b_out, b_in, out_after, in_after = self._swap_after(
+            gctx, placement, agg, r_out, r_in)
+        over_out = agg.broker_load[b_out, res] > upper[b_out]
+        under_in = (agg.broker_load[b_in, res] < lower[b_in]) & lower_active
+        helps = over_out | under_in
+        ok = (delta > 0) & helps
+        ok = ok & (in_after <= upper[b_in])
+        ok = ok & jnp.where(lower_active, out_after >= lower[b_out], True)
+        return ok
+
+    def swap_cost(self, gctx, placement, agg, r_out, r_in):
+        """Residual capacity-normalized deviation of both ends from the mean."""
+        res = self.resource
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        _, b_out, b_in, out_after, in_after = self._swap_after(
+            gctx, placement, agg, r_out, r_in)
+        cap_out = jnp.maximum(gctx.state.capacity[b_out, res], 1e-9)
+        cap_in = jnp.maximum(gctx.state.capacity[b_in, res], 1e-9)
+        return (jnp.abs(out_after / cap_out - avg)
+                + jnp.abs(in_after / cap_in - avg))
+
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Exact pairwise band check: neither end may leave the band in the
+        wrong direction once the DELTA (not the full replica load) moves."""
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        delta, _, _, out_after, in_after = self._swap_after(
+            gctx, placement, agg, r_out, r_in)
+        in_ok = (in_after <= upper[b_in]) | (delta <= 0)
+        out_ok = jnp.where(lower_active,
+                           (out_after >= lower[b_out]) | (delta <= 0), True)
+        # delta < 0 mirrors: load flows b_in -> b_out.
+        out_ok2 = (out_after <= upper[b_out]) | (delta >= 0)
+        in_ok2 = jnp.where(lower_active,
+                           (in_after >= lower[b_in]) | (delta >= 0), True)
+        return in_ok & out_ok & out_ok2 & in_ok2
 
     # ------------------------------------------------------ leadership phase
 
@@ -260,6 +345,14 @@ class PotentialNwOutGoal(Goal):
         return (agg.potential_nw_out[dst] + pot) / jnp.maximum(
             gctx.state.capacity[dst, Resource.NW_OUT], 1e-9)
 
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Only the potential-NW-out DELTA lands on each end."""
+        d = (gctx.state.leader_load[jnp.asarray(r_out), Resource.NW_OUT]
+             - gctx.state.leader_load[jnp.asarray(r_in), Resource.NW_OUT])
+        in_ok = (agg.potential_nw_out[b_in] + d <= self._limit(gctx, b_in)) | (d <= 0)
+        out_ok = (agg.potential_nw_out[b_out] - d <= self._limit(gctx, b_out)) | (d >= 0)
+        return in_ok & out_ok
+
     def stats_metric(self, gctx, placement, agg):
         b = jnp.arange(gctx.state.num_brokers_padded)
         excess = jnp.maximum(agg.potential_nw_out - self._limit(gctx, b), 0.0)
@@ -320,6 +413,20 @@ class LeaderBytesInDistributionGoal(Goal):
         after = agg.leader_bytes_in[dst] + nw_in
         was_over = agg.leader_bytes_in[dst] > limit
         return (after <= limit) | was_over & (nw_in <= 0)
+
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Only the leader-bytes-in DELTA lands on each end."""
+        r_out = jnp.asarray(r_out)
+        r_in = jnp.asarray(r_in)
+        lbi_out = jnp.where(placement.is_leader[r_out],
+                            gctx.state.leader_load[r_out, Resource.NW_IN], 0.0)
+        lbi_in = jnp.where(placement.is_leader[r_in],
+                           gctx.state.leader_load[r_in, Resource.NW_IN], 0.0)
+        d = lbi_out - lbi_in
+        limit = self._limit(gctx, agg)
+        in_ok = (agg.leader_bytes_in[b_in] + d <= limit) | (d <= 0)
+        out_ok = (agg.leader_bytes_in[b_out] - d <= limit) | (d >= 0)
+        return in_ok & out_ok
 
     def stats_metric(self, gctx, placement, agg):
         alive = alive_mask(gctx)

@@ -88,6 +88,20 @@ def _chain_accept_leadership(priors: Sequence[Goal]):
     return accept
 
 
+def _chain_accept_swap(priors: Sequence[Goal]):
+    """Both directional moves must be structurally legit, and every prior
+    goal must accept the SWAP (AbstractGoal.java:271-322 applies the swap then
+    re-checks optimized goals; goals may override accept_swap with an exact
+    pairwise predicate)."""
+    def accept(gctx, placement, agg, r_out, r_in, b_out, b_in):
+        ok = (base_replica_move_ok(gctx, placement, r_out, b_in)
+              & base_replica_move_ok(gctx, placement, r_in, b_out))
+        for g in priors:
+            ok = ok & g.accept_swap(gctx, placement, agg, r_out, r_in, b_out, b_in)
+        return ok
+    return accept
+
+
 def _pick_dst_disk(gctx: GoalContext, agg: Aggregates, dst):
     """Emptiest alive logdir of dst (disk chosen at move-apply time)."""
     frac = agg.disk_load[dst] / jnp.maximum(gctx.state.disk_capacity[dst], 1e-9)
@@ -242,6 +256,83 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     return phase
 
 
+def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
+    """Batched replica SWAP round (ResourceDistributionGoal.java:543-725).
+
+    top-k heavy replicas on loaded brokers × top-k light replicas on
+    less-loaded brokers → C×C pair feasibility (both directions structurally
+    legit ∧ every prior goal accepts the swap ∧ this goal's band math says the
+    exchange helps) → per-out-candidate best partner by residual imbalance →
+    conflict-free selection where each broker, host and partition is touched
+    by at most ONE kept swap (counting both roles), so the pre-swap
+    feasibility matrix stays valid for every kept pair.
+    """
+    accept = _chain_accept_swap(priors)
+
+    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+        state = gctx.state
+        c = num_candidates
+        b = state.num_brokers_padded
+        out_top, out_c = jax.lax.top_k(goal.swap_out_score(gctx, placement, agg), c)
+        in_top, in_c = jax.lax.top_k(goal.swap_in_score(gctx, placement, agg), c)
+
+        ro = out_c[:, None]                      # [C,1]
+        ri = in_c[None, :]                       # [1,C]
+        bo = placement.broker[ro]
+        bi = placement.broker[ri]
+        ok = ((out_top[:, None] > _SCORE_FLOOR) & (in_top[None, :] > _SCORE_FLOOR)
+              & (bo != bi)
+              & (state.partition[ro] != state.partition[ri])
+              & goal.swap_ok(gctx, placement, agg, ro, ri)
+              & accept(gctx, placement, agg, ro, ri, bo, bi))
+        cost = jnp.where(ok, goal.swap_cost(gctx, placement, agg, ro, ri), _INF_COST)
+        sel = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        feasible = jnp.take_along_axis(ok, sel[:, None], axis=1)[:, 0]
+
+        r_in_sel = in_c[sel]
+        b_out_row = placement.broker[out_c]
+        b_in_sel = placement.broker[r_in_sel]
+        order = jnp.where(feasible, jnp.arange(c, dtype=jnp.int32), c)
+
+        # A kept swap touches 2 brokers, 2 hosts, 2 partitions; each entity may
+        # appear in at most one kept swap IN EITHER ROLE, so uniqueness runs
+        # over the concatenation of both roles' keys.
+        def both_roles_winner(key_out, key_in, num_groups):
+            keys = jnp.concatenate([key_out, key_in])
+            order2 = jnp.concatenate([order, order])
+            best = jax.ops.segment_min(order2, keys, num_segments=num_groups)
+            return (best[key_out] == order) & (best[key_in] == order)
+
+        keep = (feasible
+                & both_roles_winner(b_out_row, b_in_sel, b)
+                & both_roles_winner(state.host[b_out_row], state.host[b_in_sel],
+                                    gctx.num_hosts)
+                & both_roles_winner(state.partition[out_c],
+                                    state.partition[r_in_sel],
+                                    gctx.num_partitions))
+
+        disk_for_out = _pick_dst_disk(gctx, agg, b_in_sel)   # r_out lands on b_in
+        disk_for_in = _pick_dst_disk(gctx, agg, b_out_row)   # r_in lands on b_out
+        # Non-kept rows scatter to an out-of-range dummy index (mode='drop'):
+        # r_in_sel may repeat across rows, and a non-kept duplicate writing its
+        # "no-op" value would clobber the kept row's update (last-write-wins).
+        dummy = gctx.state.num_replicas_padded
+        out_idx = jnp.where(keep, out_c, dummy)
+        in_idx = jnp.where(keep, r_in_sel, dummy)
+        new_broker = (placement.broker
+                      .at[out_idx].set(b_in_sel, mode="drop")
+                      .at[in_idx].set(b_out_row, mode="drop"))
+        new_disk = (placement.disk
+                    .at[out_idx].set(disk_for_out, mode="drop")
+                    .at[in_idx].set(disk_for_in, mode="drop"))
+        placement = placement.replace(broker=new_broker, disk=new_disk)
+        applied = jnp.sum(keep.astype(jnp.int32))
+        agg = compute_aggregates(gctx, placement)
+        return placement, agg, applied
+
+    return phase
+
+
 def _intra_disk_phase(goal: Goal, num_candidates: int):
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
         state = gctx.state
@@ -285,17 +376,14 @@ class GoalSolver:
     with identical shapes (jit caches on (goal key, priors key, shapes))."""
 
     def __init__(self, max_candidates_per_round: int = 4096,
-                 max_rounds_per_goal: int = 96):
+                 max_rounds_per_goal: int = 96,
+                 max_swap_candidates: int = 256):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
+        self.max_swap_candidates = max_swap_candidates
         self._round_cache = {}
 
-    def _round_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
-        c = min(self.max_candidates, num_replicas_padded)
-        key = (goal.key(), tuple(g.key() for g in priors), c)
-        if key in self._round_cache:
-            return self._round_cache[key]
-
+    def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []
         if getattr(goal, "is_direct", False):
             def direct(gctx, placement, agg, _goal=goal):
@@ -313,11 +401,18 @@ class GoalSolver:
             phases.append(_replica_phase(goal, priors, c,
                                          goal.pull_candidate_score, goal.self_ok,
                                          dst_mask_fn=goal.pull_dst_mask))
+        if goal.has_swap_phase:
+            # Swap pairs are C×C; keep the tile small — swaps are the
+            # last-resort mechanism, a few per round suffice.
+            phases.append(_swap_phase(goal, priors, min(self.max_swap_candidates, c)))
         if getattr(goal, "intra_disk", False):
             phases.append(_intra_disk_phase(goal, c))
+        return phases
 
-        @jax.jit
-        def round_fn(gctx: GoalContext, placement: Placement):
+    def _round_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
+        phases = self._phases(goal, priors, c)
+
+        def round_body(gctx: GoalContext, placement: Placement):
             agg = compute_aggregates(gctx, placement)
             applied = jnp.int32(0)
             for phase in phases:
@@ -329,41 +424,85 @@ class GoalSolver:
             metric = goal.stats_metric(gctx, placement, agg)
             return placement, applied, violated, stranded, metric
 
+        return round_body
+
+    def _round_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
+        """One jitted solver round (kept for the driver's single-chip
+        compile check and for round-granular tests)."""
+        c = min(self.max_candidates, num_replicas_padded)
+        key = ("round", goal.key(), tuple(g.key() for g in priors), c)
+        if key in self._round_cache:
+            return self._round_cache[key]
+        round_fn = jax.jit(self._round_body(goal, priors, c))
         self._round_cache[key] = round_fn
         return round_fn
+
+    def _solve_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
+        """The whole per-goal convergence loop as ONE jitted dispatch.
+
+        The reference's ``while !finished`` loop (GoalOptimizer.java:437-462)
+        is a single Java call; a host-side Python loop here would pay a
+        dispatch+sync round-trip per round — fatal over a tunneled TPU
+        backend.  ``lax.while_loop`` keeps every round on-device; the carry is
+        (placement, rounds, applied_last, moves_total, violated, stranded,
+        metric) and the condition mirrors the host loop exactly:
+        work remains ∧ last round made progress ∧ round budget left.
+        """
+        c = min(self.max_candidates, num_replicas_padded)
+        key = ("solve", goal.key(), tuple(g.key() for g in priors), c)
+        if key in self._round_cache:
+            return self._round_cache[key]
+        round_body = self._round_body(goal, priors, c)
+        max_rounds = jnp.int32(self.max_rounds)
+
+        @jax.jit
+        def solve(gctx: GoalContext, placement: Placement):
+            agg0 = compute_aggregates(gctx, placement)
+            violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
+                                .astype(jnp.int32))
+            stranded0 = jnp.sum(currently_offline(gctx, placement)
+                                .astype(jnp.int32))
+            metric0 = goal.stats_metric(gctx, placement, agg0)
+
+            def cond(carry):
+                _, rounds, applied_last, _, violated, stranded, _ = carry
+                work = (violated > 0) | (stranded > 0)
+                progress = (rounds == 0) | (applied_last > 0)
+                return work & progress & (rounds < max_rounds)
+
+            def body(carry):
+                pl, rounds, _, moves, _, _, _ = carry
+                pl, applied, violated, stranded, metric = round_body(gctx, pl)
+                return (pl, rounds + 1, applied, moves + applied,
+                        violated, stranded, metric)
+
+            init = (placement, jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                    violated0, stranded0, metric0)
+            pl, rounds, _, moves, violated, stranded, metric = \
+                jax.lax.while_loop(cond, body, init)
+            return (pl, rounds, moves, violated, stranded, metric,
+                    violated0, metric0)
+
+        self._round_cache[key] = solve
+        return solve
 
     def optimize_goal(self, goal: Goal, priors: Sequence[Goal], gctx: GoalContext,
                       placement: Placement) -> Tuple[Placement, GoalOptimizationInfo]:
         """Run rounds until converged (the reference's per-goal
-        ``while !finished`` loop, GoalOptimizer.java:437-462)."""
-        round_fn = self._round_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
-        info = GoalOptimizationInfo(goal_name=goal.name)
-
-        agg0 = compute_aggregates(gctx, placement)
-        info.violated_brokers_before = int(jnp.sum(
-            goal.violated_brokers(gctx, placement, agg0)))
-        info.metric_before = float(goal.stats_metric(gctx, placement, agg0))
-
-        violated = info.violated_brokers_before
-        stranded = int(jnp.sum(currently_offline(gctx, placement)))
-        if violated == 0 and stranded == 0:
-            # Nothing to do — don't pay for a full scoring round.
-            info.metric_after = info.metric_before
-            return placement, info
-        for _ in range(self.max_rounds):
-            if violated == 0 and stranded == 0 and info.rounds > 0:
-                break
-            placement, applied, violated_d, stranded_d, metric_d = round_fn(
-                gctx, placement)
-            applied = int(applied)
-            violated = int(violated_d)
-            stranded = int(stranded_d)
-            info.rounds += 1
-            info.moves_applied += applied
-            info.metric_after = float(metric_d)
-            if applied == 0:
-                break
-        info.violated_brokers_after = violated
+        ``while !finished`` loop, GoalOptimizer.java:437-462) — one device
+        dispatch and one host sync per goal."""
+        solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
+        placement, rounds, moves, violated, stranded, metric, violated0, metric0 = \
+            solve(gctx, placement)
+        info = GoalOptimizationInfo(
+            goal_name=goal.name,
+            rounds=int(rounds),
+            moves_applied=int(moves),
+            violated_brokers_before=int(violated0),
+            violated_brokers_after=int(violated),
+            metric_before=float(metric0),
+            metric_after=float(metric) if int(rounds) > 0 else float(metric0),
+        )
         return placement, info
 
 

@@ -130,6 +130,28 @@ def unbalanced5() -> ClusterModel:
     return _create_unbalanced((T1, T2), 14)
 
 
+def swap_only_balanceable() -> ClusterModel:
+    """Two brokers where NO single replica move can stay inside the NW_IN
+    balance band — the hot broker's lightest replica still overshoots the cold
+    broker's upper bound — but one swap balances both exactly.
+
+    b0 holds NW_IN loads {10, 8} (util 18/20), b1 holds {4, 2} (util 6/20);
+    avg util 0.6, band [10.8, 13.2].  Moving 8 → b1 gives 14 > 13.2 (reject);
+    swapping 10 ↔ 4 gives 12 / 12 (in band).  Exercises the solver's swap
+    phase (reference mechanism: ResourceDistributionGoal.java:543-725).
+    """
+    capacity = {Resource.CPU: TYPICAL_CPU_CAPACITY, Resource.NW_IN: 20.0,
+                Resource.NW_OUT: MEDIUM_BROKER_CAPACITY,
+                Resource.DISK: LARGE_BROKER_CAPACITY}
+    cm = homogeneous_cluster({0: 0, 1: 1}, capacity=capacity)
+    nw_in = {(T1, 0): (0, 10.0), (T1, 1): (0, 8.0),
+             (T2, 0): (1, 4.0), (T2, 1): (1, 2.0)}
+    for (topic, part), (broker, value) in nw_in.items():
+        cm.create_replica(topic, part, broker_id=broker, index=0, is_leader=True)
+        cm.set_replica_load(topic, part, broker, load(1.0, value, 0.0, 1.0))
+    return cm
+
+
 def rack_aware_satisfiable() -> ClusterModel:
     """Two racks, three brokers, one partition × 2 replicas on brokers 0,1 (same rack)."""
     cm = homogeneous_cluster(RACK_BY_BROKER)

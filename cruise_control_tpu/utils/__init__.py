@@ -1,0 +1,3 @@
+from cruise_control_tpu.utils.hermetic import force_cpu, probe_tpu
+
+__all__ = ["force_cpu", "probe_tpu"]

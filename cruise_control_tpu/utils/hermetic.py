@@ -1,0 +1,55 @@
+"""Backend selection guards for tunneled-TPU environments.
+
+The axon PJRT plugin registers itself in every interpreter (sitecustomize),
+and JAX backend discovery initializes *every* registered plugin regardless of
+``JAX_PLATFORMS`` — so a process that must stay CPU-only (tests, dry runs,
+benchmark fallback) has to deregister the factory *and* override the already-
+captured config before the first backend lookup.  One canonical copy of that
+recipe lives here; ``tests/conftest.py``, ``bench.py`` and
+``__graft_entry__.py`` all route through it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def force_cpu(n_devices: Optional[int] = None) -> None:
+    """Force JAX onto the host-CPU platform, optionally with ``n_devices``
+    virtual devices.  Must run before the first backend initialization; safe
+    to call again afterwards (idempotent env/config writes).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        parts = [f for f in flags.split() if
+                 "xla_force_host_platform_device_count" not in f]
+        parts.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(parts)
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+
+
+def probe_tpu(timeout_s: float = 180.0) -> bool:
+    """True iff a non-CPU accelerator backend initializes in a throwaway
+    subprocess.  TPU-tunnel init can hang or raise (tunnel down, libtpu
+    version skew); probing out-of-process with a timeout keeps the caller
+    alive either way."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, sys; d = jax.devices(); "
+             "sys.exit(0 if d and d[0].platform != 'cpu' else 1)"],
+            timeout=timeout_s, capture_output=True)
+        return probe.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False

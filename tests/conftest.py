@@ -1,9 +1,10 @@
 """Test bootstrap: run JAX on a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is unavailable in CI; sharding correctness is validated
-on host-platform virtual devices instead.  Must run before the first jax import.
+on host-platform virtual devices instead.  Must run before the first backend
+initialization.
 
-Two traps this guards against:
+Two traps this guards against (handled by ``utils.hermetic.force_cpu``):
 - ``JAX_PLATFORMS`` is preset to ``axon`` in the environment, so ``setdefault``
   would silently leave tests running on the real TPU chip.
 - The axon PJRT plugin registers at interpreter start (sitecustomize) and
@@ -13,17 +14,6 @@ Two traps this guards against:
   hermetic and CPU-only.
 """
 
-import os
+from cruise_control_tpu.utils.hermetic import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-# sitecustomize imported jax before this file ran, so the config already
-# captured JAX_PLATFORMS=axon — override it through the config API too.
-jax.config.update("jax_platforms", "cpu")
-_xb._backend_factories.pop("axon", None)
+force_cpu(n_devices=8)

@@ -240,3 +240,26 @@ def test_intra_broker_disk_balance():
     final = report.result.final_placement
     # Broker assignment untouched; only disks may change.
     assert (np.asarray(final.broker) == np.asarray(placement.broker)).all()
+
+
+def test_swap_balances_low_headroom_cluster():
+    """swap_only_balanceable(): no single move fits the band; only a swap
+    (reference's third mechanism, ResourceDistributionGoal.java:543-725)
+    balances NW_IN.  Replica counts per broker must not change."""
+    state, placement, meta = freeze(det.swap_only_balanceable())
+    report = execute_goals_for(state, placement, meta,
+                               ["NetworkInboundUsageDistributionGoal"],
+                               verifications=("GOAL_VIOLATION",))
+    assert report.ok, report.failures
+    final = report.result.final_placement
+    bl = np.asarray(ops.broker_load(state, final))
+    nw = bl[:2, Resource.NW_IN]
+    cap = np.asarray(state.capacity)[:2, Resource.NW_IN]
+    avg = nw.sum() / cap.sum()
+    upper = avg * 1.1 * cap
+    lower = avg * (2 - 1.1) * cap
+    assert (nw <= upper + 1e-4).all() and (nw >= lower - 1e-4).all(), nw
+    counts = np.bincount(np.asarray(final.broker)[:meta.num_replicas], minlength=2)
+    assert counts[0] == 2 and counts[1] == 2, counts
+    moved = (np.asarray(final.broker) != np.asarray(placement.broker))[:meta.num_replicas]
+    assert moved.sum() >= 2  # a swap relocates two replicas
