@@ -1,14 +1,18 @@
 """Benchmark: full multi-goal proposal generation wall-clock.
 
-BASELINE.md config #3: RandomCluster 200 brokers / 50K replicas, full
-hard-goal stack + ResourceDistribution soft goals.  The north-star budget
-(BASELINE.json) is a <10 s full proposal at 2.6K brokers / 1M replicas on one
-v5e chip; this bench reports the 200-broker config so every round has a
-comparable number, with ``vs_baseline`` = north-star-budget / measured (>1 ⇒
-inside budget).  Wall-clock excludes one warmup solve (jit compile is cached
-across snapshots of the same size class in production).
+Three BASELINE.md configs, one JSON line each (headline LAST):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
+  64-lane vmapped scenario batch through the production
+  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack).
+- config #4: 2.6K brokers / 1M replicas, full default goal stack — the
+  north-star scale (<10 s budget on one v5e chip).
+- config #3 (headline): RandomCluster 200 brokers / 50K replicas, full
+  hard-goal stack + distribution soft goals — comparable across rounds.
+
+``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
+Wall-clock excludes one warmup solve (jit compile is cached across snapshots
+of the same size class in production).
 """
 
 from __future__ import annotations
@@ -52,33 +56,84 @@ GOALS = [
 
 def main() -> None:
     backend = select_backend()
+    try:
+        run(backend)
+    except Exception:
+        if backend == "cpu":
+            raise
+        # The probe passed but the tunneled TPU backend died mid-run (e.g.
+        # libtpu client/terminal version skew raises FAILED_PRECONDITION at
+        # first dispatch).  Re-exec clean on CPU so the bench still reports.
+        import os
+        import sys
+        import traceback
+        traceback.print_exc()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
 
-    from cruise_control_tpu.analyzer import BalancingConstraint, GoalOptimizer
+
+HARD_GOALS = GOALS[:6]
+
+
+def _emit(metric: str, seconds: float, backend: str) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(seconds, 4),
+        "unit": "seconds",
+        "vs_baseline": round(NORTH_STAR_BUDGET_S / max(seconds, 1e-9), 3),
+        "backend": backend,
+    }), flush=True)
+
+
+def _timed(fn) -> float:
+    fn()                      # warmup: populate per-goal jit caches
+    t0 = time.monotonic()
+    fn()
+    return time.monotonic() - t0
+
+
+def run(backend: str) -> None:
+    from cruise_control_tpu.analyzer import GoalOptimizer
     from cruise_control_tpu.testing import random_cluster as rc
 
+    # ---- config #3 (headline) first, so a number exists even if the harness
+    # cuts the run short; re-emitted last for tail parsers.
     props = rc.ClusterProperties(
         num_brokers=200, num_racks=10, num_topics=1000, num_replicas=50_000,
         mean_cpu=0.006, mean_disk=90.0, mean_nw_in=90.0, mean_nw_out=90.0,
         seed=3140)
     state, placement, meta = rc.generate(props)
+    optimizer = GoalOptimizer(goal_names=GOALS)
+    headline = _timed(lambda: optimizer.optimizations(state, placement, meta))
+    _emit("proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
+          headline, backend)
+    del state, placement, optimizer
 
-    constraint = BalancingConstraint()
-    optimizer = GoalOptimizer(constraint=constraint, goal_names=GOALS)
+    # ---- configs #4/#5 fixture: north-star scale (2.6K brokers / 1M replicas)
+    big = rc.ClusterProperties(
+        num_brokers=2600, num_racks=40, num_topics=2000, num_replicas=1_000_000,
+        mean_cpu=0.0035, mean_disk=90.0, mean_nw_in=90.0, mean_nw_out=90.0,
+        seed=3141)
+    b_state, b_placement, b_meta = rc.generate(big)
 
-    # Warmup: populates the per-goal jit caches (one compile per goal class).
-    optimizer.optimizations(state, placement, meta)
+    # config #5: 64 decommission what-ifs, one vmapped program per goal.
+    sets = [[b] for b in range(64)]
+    opt_hard = GoalOptimizer(goal_names=HARD_GOALS)
+    elapsed = _timed(lambda: opt_hard.batch_remove_scenarios(
+        b_state, b_placement, b_meta, sets, num_candidates=512))
+    _emit("remove_broker_what_ifs_x64_2600brokers_1m_replicas_hard_goals",
+          elapsed, backend)
 
-    t0 = time.monotonic()
-    result = optimizer.optimizations(state, placement, meta)
-    elapsed = time.monotonic() - t0
+    # config #4: full default stack at north-star scale.
+    opt_big = GoalOptimizer(goal_names=GOALS)
+    elapsed = _timed(lambda: opt_big.optimizations(b_state, b_placement, b_meta))
+    _emit("proposal_generation_wall_clock_2600brokers_1m_replicas_full_goals",
+          elapsed, backend)
+    del b_state, b_placement, opt_big, opt_hard
 
-    print(json.dumps({
-        "metric": "proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
-        "value": round(elapsed, 4),
-        "unit": "seconds",
-        "vs_baseline": round(NORTH_STAR_BUDGET_S / max(elapsed, 1e-9), 3),
-        "backend": backend,
-    }))
+    # Headline repeated LAST: the driver's artifact parser takes the tail line.
+    _emit("proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
+          headline, backend)
 
 
 if __name__ == "__main__":

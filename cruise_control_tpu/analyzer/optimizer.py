@@ -34,6 +34,7 @@ from cruise_control_tpu.analyzer.solver import (
     default_solver,
 )
 from cruise_control_tpu.common.actions import ExecutionProposal, ProposalSummary
+from cruise_control_tpu.common.exceptions import OptimizationFailureError
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
 
@@ -108,6 +109,36 @@ def balancedness_score(goal_infos: Sequence[GoalOptimizationInfo],
     return 100.0 * got / total if total else 100.0
 
 
+@dataclass
+class BatchScenarioResult:
+    """Result of a vmapped what-if batch (one lane per scenario).
+
+    Reference analog: ``servlet/handler/async/runnable/RemoveBrokersRunnable``
+    run N times sequentially; here all N solves share one compiled program.
+    """
+
+    removal_sets: List[List[int]]
+    goal_names: List[str]
+    violated_after: np.ndarray      # i32[S, G] violated brokers per scenario/goal
+    moves: np.ndarray               # i32[S, G]
+    rounds: np.ndarray              # i32[S, G]
+    stranded_after: np.ndarray      # i32[S] offline replicas left (last goal)
+    final_placements: Placement     # stacked [S, ...] pytree
+
+    @property
+    def num_scenarios(self) -> int:
+        return len(self.removal_sets)
+
+    def succeeded(self, s: int) -> bool:
+        """Scenario s evacuated everything and satisfies every goal."""
+        return (int(self.stranded_after[s]) == 0
+                and int(self.violated_after[s].sum()) == 0)
+
+    def placement_for(self, s: int) -> Placement:
+        import jax
+        return jax.tree_util.tree_map(lambda x: x[s], self.final_placements)
+
+
 class GoalOptimizer:
     """Runs a prioritized goal list over a frozen snapshot; caches the last
     result per model generation (GoalOptimizer.java:196-224 cache semantics)."""
@@ -169,6 +200,18 @@ class GoalOptimizer:
         ]
         stats_before = compute_stats(state, placement, self.constraint.balance_threshold)
 
+        # AbstractGoal.java:108-117: the stats-must-not-worsen contract is
+        # waived only when the cluster has broken brokers or excluded-for-move
+        # brokers still holding replicas (evacuation may legitimately worsen
+        # a soft metric).
+        has_broken = bool((~np.asarray(state.alive)
+                           & np.asarray(state.broker_valid)).any())
+        excl_move = np.asarray(gctx.excluded_for_replica_move)
+        if excl_move.any():
+            held = np.bincount(np.asarray(placement.broker)[np.asarray(state.valid)],
+                               minlength=excl_move.shape[0])
+            has_broken = has_broken or bool((excl_move & (held > 0)).any())
+
         infos: List[GoalOptimizationInfo] = []
         priors: List[Goal] = []
         for goal in goals:
@@ -182,10 +225,16 @@ class GoalOptimizer:
                 stranded = int(np.sum(np.asarray(
                     currently_offline(gctx, placement))))
             check_hard_goal(goal, info, stranded)
-            if info.metric_after > info.metric_before and info.rounds > 0:
-                # AbstractGoal.java:108-117: stats must not get worse.
-                LOG.warning("goal %s metric worsened: %.6g -> %.6g",
-                            goal.name, info.metric_before, info.metric_after)
+            worsened = (info.rounds > 0 and info.metric_after
+                        > info.metric_before * (1 + 1e-5) + 1e-9)
+            if worsened and not has_broken:
+                raise OptimizationFailureError(
+                    f"[{goal.name}] optimized result is worse than before: "
+                    f"{info.metric_before:.6g} -> {info.metric_after:.6g}")
+            elif worsened:
+                LOG.warning("goal %s metric worsened during evacuation: "
+                            "%.6g -> %.6g", goal.name,
+                            info.metric_before, info.metric_after)
             priors.append(goal)
 
         aggN = compute_aggregates(gctx, placement)
@@ -211,3 +260,80 @@ class GoalOptimizer:
             with self._cache_lock:
                 self._cached = {cache_key: result}   # keep only latest generation
         return result
+
+    # ------------------------------------------------- vmapped what-if batch
+
+    def batch_remove_scenarios(
+        self,
+        state: ClusterState,
+        placement: Placement,
+        meta: ClusterMeta,
+        removal_sets: Sequence[Sequence[int]],
+        options: Optional[OptimizationOptions] = None,
+        goals: Optional[Sequence[Goal]] = None,
+        num_candidates: int = 512,
+    ) -> BatchScenarioResult:
+        """Solve S independent remove-broker what-ifs as ONE vmapped program
+        per goal (BASELINE config #5; SURVEY §7 'jit once, vmap over
+        scenarios').
+
+        The reference runs ``RemoveBrokersRunnable`` once per request,
+        serializing N decommission studies; here each scenario is a vmap lane
+        whose liveness/exclusion masks differ, so the entire fleet of what-ifs
+        costs one compiled solve per goal.  Scenario-dependent context (host
+        capacity) is recomputed inside the trace.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        options = options or OptimizationOptions()
+        goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
+        gctx = build_context(state, placement, meta, self.constraint, options)
+
+        s_n = len(removal_sets)
+        id_to_idx = {int(bid): i for i, bid in enumerate(meta.broker_ids)}
+        base_alive = np.asarray(state.alive)
+        base_excl_move = np.asarray(gctx.excluded_for_replica_move)
+        base_excl_lead = np.asarray(gctx.excluded_for_leadership)
+        alive_s = np.tile(base_alive, (s_n, 1))
+        excl_move_s = np.tile(base_excl_move, (s_n, 1))
+        excl_lead_s = np.tile(base_excl_lead, (s_n, 1))
+        for s, ids in enumerate(removal_sets):
+            for bid in ids:
+                i = id_to_idx[int(bid)]
+                alive_s[s, i] = False
+                excl_move_s[s, i] = True
+                excl_lead_s[s, i] = True
+        alive_j = jnp.asarray(alive_s)
+        excl_move_j = jnp.asarray(excl_move_s)
+        excl_lead_j = jnp.asarray(excl_lead_s)
+
+        placement_s = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (s_n,) + x.shape), placement)
+
+        g_n = len(goals)
+        violated = np.zeros((s_n, g_n), dtype=np.int64)
+        moves = np.zeros((s_n, g_n), dtype=np.int64)
+        rounds = np.zeros((s_n, g_n), dtype=np.int64)
+        stranded = np.zeros(s_n, dtype=np.int64)
+        priors: List[Goal] = []
+        for gi, goal in enumerate(goals):
+            batch = self.solver._batch_solve_fn(
+                goal, tuple(priors), state.num_replicas_padded, num_candidates)
+            (placement_s, rounds_d, moves_d, violated_d, stranded_d,
+             *_rest) = batch(gctx, alive_j, excl_move_j, excl_lead_j, placement_s)
+            violated[:, gi] = np.asarray(violated_d)
+            moves[:, gi] = np.asarray(moves_d)
+            rounds[:, gi] = np.asarray(rounds_d)
+            stranded = np.asarray(stranded_d)
+            priors.append(goal)
+
+        return BatchScenarioResult(
+            removal_sets=[list(map(int, ids)) for ids in removal_sets],
+            goal_names=[g.name for g in goals],
+            violated_after=violated,
+            moves=moves,
+            rounds=rounds,
+            stranded_after=stranded,
+            final_placements=placement_s,
+        )

@@ -452,10 +452,14 @@ class GoalSolver:
         key = ("solve", goal.key(), tuple(g.key() for g in priors), c)
         if key in self._round_cache:
             return self._round_cache[key]
+        solve = jax.jit(self._solve_body(goal, priors, c))
+        self._round_cache[key] = solve
+        return solve
+
+    def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         round_body = self._round_body(goal, priors, c)
         max_rounds = jnp.int32(self.max_rounds)
 
-        @jax.jit
         def solve(gctx: GoalContext, placement: Placement):
             agg0 = compute_aggregates(gctx, placement)
             violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
@@ -483,8 +487,42 @@ class GoalSolver:
             return (pl, rounds, moves, violated, stranded, metric,
                     violated0, metric0)
 
-        self._round_cache[key] = solve
         return solve
+
+    def _batch_solve_fn(self, goal: Goal, priors: Tuple[Goal, ...],
+                        num_replicas_padded: int, num_candidates: int):
+        """Vmapped per-goal solve over a SCENARIO axis (BASELINE config #5 /
+        'jit once, vmap over scenarios', SURVEY §7).
+
+        Each scenario supplies its own broker-liveness and exclusion masks
+        (a remove-broker what-if kills different brokers); scenario-dependent
+        context entries (host capacity) are recomputed in-trace so every
+        lane's band/capacity math sees its own cluster.
+        """
+        c = min(num_candidates, num_replicas_padded)
+        key = ("batch", goal.key(), tuple(g.key() for g in priors), c)
+        if key in self._round_cache:
+            return self._round_cache[key]
+        solve_body = self._solve_body(goal, priors, c)
+
+        @jax.jit
+        def batch(gctx: GoalContext, alive_s, excl_move_s, excl_lead_s,
+                  placement_s):
+            def one(alive, excl_move, excl_lead, placement):
+                state = gctx.state.replace(alive=alive)
+                ok = alive & state.broker_valid
+                host_cap = jax.ops.segment_sum(
+                    jnp.where(ok[:, None], state.capacity, 0.0),
+                    state.host, num_segments=gctx.num_hosts)
+                g2 = gctx.replace(
+                    state=state, host_capacity=host_cap,
+                    excluded_for_replica_move=excl_move,
+                    excluded_for_leadership=excl_lead)
+                return solve_body(g2, placement)
+            return jax.vmap(one)(alive_s, excl_move_s, excl_lead_s, placement_s)
+
+        self._round_cache[key] = batch
+        return batch
 
     def optimize_goal(self, goal: Goal, priors: Sequence[Goal], gctx: GoalContext,
                       placement: Placement) -> Tuple[Placement, GoalOptimizationInfo]:

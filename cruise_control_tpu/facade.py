@@ -261,6 +261,23 @@ class CruiseControl:
             excluded_brokers_for_leadership=frozenset(ids))
         return self._run_operation(goals, options, dryrun, model_mutator=mutate)
 
+    def remove_brokers_batch(self, removal_sets: Sequence[Sequence[int]],
+                             goals: Optional[Sequence[str]] = None,
+                             num_candidates: int = 512):
+        """Batch decommission study: solve every removal set as a vmap lane of
+        one compiled program (BASELINE config #5).  The reference would run
+        ``RemoveBrokersRunnable`` once per set; this shares the model build
+        and the per-goal compilation across all scenarios."""
+        builder = self.load_monitor.cluster_model_builder()
+        state, placement, meta = builder.freeze(pad_replicas_to=PAD_R,
+                                                pad_brokers_to=PAD_B)
+        goal_names = list(goals or self.default_goals)
+        optimizer = (self.optimizer if goal_names == self.default_goals
+                     else GoalOptimizer(constraint=self.constraint,
+                                        goal_names=goal_names))
+        return optimizer.batch_remove_scenarios(
+            state, placement, meta, removal_sets, num_candidates=num_candidates)
+
     def demote_brokers(self, broker_ids: Sequence[int],
                        dryrun: bool = True) -> OperationResult:
         """POST /demote_broker (DemoteBrokerRunnable): move leadership off

@@ -289,17 +289,54 @@ class MetricSampleAggregator:
                 rv = np.roll(vals, -1, axis=1)
                 fill = (lv + rv) / 2.0
                 vals = np.where(adjacent[:, :, None], fill, vals)
-            # Fill FORECAST values: carry forward the most recent non-empty
-            # window (constant forecast — robust, and what AVG_AVAILABLE-style
-            # degradation amounts to for short histories).
+            # Fill FORECAST values: weighted linear fit over the most recent
+            # prior non-empty windows (reference RawMetricValues FORECAST —
+            # least-squares over up to 5 earlier windows), vectorized with
+            # prefix sums restricted to the entities that need it.  A single
+            # prior point degenerates to carry-forward (slope 0).
             if forecast.any():
-                carried = vals.copy()
-                nonempty = counts > 0
+                rows = np.nonzero(forecast.any(axis=1))[0]
+                v = vals[rows].astype(np.float64)            # [E', W, M]
+                nonempty = counts[rows] > 0                  # [E', W]
+                x = np.arange(w_n, dtype=np.float64)[None, :]
+                xm = np.where(nonempty, x, 0.0)
+                nm = nonempty.astype(np.float64)
+                ym = np.where(nonempty[:, :, None], v, 0.0)
+
+                def last5_prior(a):
+                    """Sum of a over the 5 windows preceding each w."""
+                    pad_shape = (a.shape[0], 1) + a.shape[2:]
+                    cum = np.concatenate(
+                        [np.zeros(pad_shape, a.dtype), np.cumsum(a, axis=1)],
+                        axis=1)                              # cum[:, w] = sum < w
+                    lo = np.maximum(np.arange(w_n) - 5, 0)
+                    return cum[:, np.arange(w_n)] - cum[:, lo]
+
+                n_p = last5_prior(nm)                        # [E', W]
+                sx_p = last5_prior(xm)
+                sxx_p = last5_prior(xm * xm)
+                sy_p = last5_prior(ym)                       # [E', W, M]
+                sxy_p = last5_prior(xm[:, :, None] * ym)
+                denom = n_p * sxx_p - sx_p ** 2              # [E', W]
+                safe = np.maximum(denom, 1e-12)[:, :, None]
+                slope = np.where((denom > 1e-12)[:, :, None],
+                                 (n_p[:, :, None] * sxy_p
+                                  - sx_p[:, :, None] * sy_p) / safe, 0.0)
+                n_safe = np.maximum(n_p, 1.0)[:, :, None]
+                intercept = (sy_p - slope * sx_p[:, :, None]) / n_safe
+                pred = np.maximum(intercept + slope * x[:, :, None], 0.0)
+                # Classification (has_prior) looks back unboundedly; when the
+                # nearest non-empty window is >5 back (n_p == 0) the fit has
+                # no points — fall back to carrying the last value forward.
+                carried = v.copy()
+                seen = nonempty.copy()
                 for w in range(1, w_n):
-                    need = ~nonempty[:, w]
+                    need = ~seen[:, w]
                     carried[need, w, :] = carried[need, w - 1, :]
-                    nonempty[:, w] |= nonempty[:, w - 1]
-                vals = np.where(forecast[:, :, None], carried, vals)
+                    seen[:, w] |= seen[:, w - 1]
+                pred = np.where((n_p > 0)[:, :, None], pred, carried)
+                sel = forecast[rows][:, :, None]
+                vals[rows] = np.where(sel, pred, vals[rows])
 
             num_extrapolated = (some | adjacent | forecast).sum(axis=1)
             entity_valid = (~invalid).all(axis=1) & (

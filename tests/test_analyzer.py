@@ -263,3 +263,57 @@ def test_swap_balances_low_headroom_cluster():
     assert counts[0] == 2 and counts[1] == 2, counts
     moved = (np.asarray(final.broker) != np.asarray(placement.broker))[:meta.num_replicas]
     assert moved.sum() >= 2  # a swap relocates two replicas
+
+
+def test_batch_remove_scenarios():
+    """Vmapped what-if batch: each scenario decommissions a different broker;
+    every lane's dead broker must end up empty, and lanes must differ."""
+    from cruise_control_tpu.testing import random_cluster as rc
+    props = rc.ClusterProperties(num_brokers=8, num_racks=4, num_topics=12,
+                                 num_replicas=256, seed=11)
+    state, placement, meta = rc.generate(props)
+    opt = GoalOptimizer(goal_names=[
+        "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+        "ReplicaDistributionGoal"])
+    removal_sets = [[0], [1], [2], [3]]
+    res = opt.batch_remove_scenarios(state, placement, meta, removal_sets,
+                                     num_candidates=64)
+    assert res.num_scenarios == 4
+    for s, ids in enumerate(removal_sets):
+        assert int(res.stranded_after[s]) == 0, (s, res.stranded_after)
+        pl = res.placement_for(s)
+        brokers = np.asarray(pl.broker)[np.asarray(state.valid)]
+        for bid in ids:
+            assert (brokers != bid).all(), f"scenario {s}: broker {bid} not evacuated"
+    # Lanes are independent: scenario 0 keeps broker 1 populated.
+    pl0 = np.asarray(res.placement_for(0).broker)[np.asarray(state.valid)]
+    assert (pl0 == 1).any()
+
+
+def test_solution_quality_stdev_contract():
+    """Solution-quality ratchet on the DeterministicCluster fixtures: the full
+    default stack must cut the per-resource utilization CV (stdev/avg) on the
+    unbalanced fixtures and never worsen it, and every fixture's post-solve CV
+    must stay under a recorded bound (quality, not just violation counts —
+    reference ClusterModelStatsComparator semantics, Goal.java:137-156)."""
+    from cruise_control_tpu.analyzer.goals.registry import DEFAULT_GOALS
+    from cruise_control_tpu.model.stats import compute_stats
+
+    # Recorded post-optimization CV upper bounds per fixture (ratchet: tighten
+    # when the solver improves; never loosen without a quality argument).
+    # (unbalanced2/3/5 are capacity-infeasible by construction with default
+    # thresholds and cannot run the full default stack.)
+    bounds = {"unbalanced": 0.75, "unbalanced_with_a_follower": 0.75}
+    fixtures = {"unbalanced": det.unbalanced,
+                "unbalanced_with_a_follower": det.unbalanced_with_a_follower}
+    for name, fx in fixtures.items():
+        state, placement, meta = freeze(fx())
+        report = execute_goals_for(state, placement, meta, list(DEFAULT_GOALS))
+        assert report.ok, (name, report.failures)
+        before = report.result.stats_before
+        after = report.result.stats_after
+        cv_b, cv_a = before.cv(), after.cv()
+        # Never worsen a resource that mattered (avg > 0).
+        active = np.asarray(before.avg_util) > 1e-9
+        assert (cv_a[active] <= cv_b[active] + 1e-6).all(), (name, cv_b, cv_a)
+        assert float(cv_a[active].max()) <= bounds[name], (name, cv_a)

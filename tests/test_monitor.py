@@ -288,3 +288,36 @@ def test_completeness_empty_before_first_completed_window():
     fill(agg, e, [1_700_000 // W], per_window=1)  # single active window only
     comp = agg.completeness(-np.inf, np.inf)
     assert comp.valid_windows == []
+
+
+def test_forecast_is_linear_fit():
+    """FORECAST must extrapolate the trend (reference RawMetricValues does a
+    linear fit over recent windows), not carry the last value forward."""
+    agg = _agg()
+    e = ("t", 0)
+    for w, cpu in zip([0, 1, 2], [1.0, 2.0, 3.0]):   # slope +1/window
+        fill(agg, e, [w], cpu=cpu)
+    fill(agg, ("other", 1), [3, 4], per_window=2)    # windows 3,4 empty for e
+    fill(agg, ("other", 1), [5], per_window=1)       # active window
+    res = agg.aggregate(0, 6 * W)
+    vae = res.values_and_extrapolations[e]
+    w3 = vae.windows.index(3)
+    w4 = vae.windows.index(4)
+    assert vae.extrapolations[w3] is Extrapolation.FORECAST
+    assert vae.values[md.CPU_USAGE, w3] == pytest.approx(4.0, abs=1e-3)
+    assert vae.values[md.CPU_USAGE, w4] == pytest.approx(5.0, abs=1e-3)
+
+
+def test_forecast_far_gap_carries_forward():
+    """When the nearest non-empty window is >5 back, the linear fit has no
+    points in its lookback — the fill must carry the last value, not emit 0."""
+    agg = _agg(num_windows=12, max_allowed_extrapolations_per_entity=11)
+    e = ("t", 0)
+    fill(agg, e, [0], cpu=5.0)
+    fill(agg, ("other", 1), list(range(1, 12)))      # keep windows completing
+    fill(agg, ("other", 1), [12], per_window=1)      # active
+    res = agg.aggregate(0, 13 * W)
+    vae = res.values_and_extrapolations[e]
+    for w in (7, 9, 10):
+        wi = vae.windows.index(w)
+        assert vae.values[md.CPU_USAGE, wi] == pytest.approx(5.0, abs=1e-3), w
