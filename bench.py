@@ -58,12 +58,13 @@ def main() -> None:
     backend = select_backend()
     try:
         run(backend)
-    except Exception:
-        if backend == "cpu":
+    except Exception as e:
+        from jax.errors import JaxRuntimeError
+        # Only a backend/runtime death warrants the CPU retry (e.g. libtpu
+        # client/terminal version skew raising FAILED_PRECONDITION at first
+        # dispatch).  Application errors must fail fast and loud.
+        if backend == "cpu" or not isinstance(e, (JaxRuntimeError, OSError)):
             raise
-        # The probe passed but the tunneled TPU backend died mid-run (e.g.
-        # libtpu client/terminal version skew raises FAILED_PRECONDITION at
-        # first dispatch).  Re-exec clean on CPU so the bench still reports.
         import os
         import sys
         import traceback
@@ -75,13 +76,14 @@ def main() -> None:
 HARD_GOALS = GOALS[:6]
 
 
-def _emit(metric: str, seconds: float, backend: str) -> None:
+def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
     print(json.dumps({
         "metric": metric,
         "value": round(seconds, 4),
         "unit": "seconds",
         "vs_baseline": round(NORTH_STAR_BUDGET_S / max(seconds, 1e-9), 3),
         "backend": backend,
+        **extra,
     }), flush=True)
 
 
@@ -116,20 +118,33 @@ def run(backend: str) -> None:
         seed=3141)
     b_state, b_placement, b_meta = rc.generate(big)
 
-    # config #5: 64 decommission what-ifs, one vmapped program per goal.
-    sets = [[b] for b in range(64)]
-    opt_hard = GoalOptimizer(goal_names=HARD_GOALS)
-    elapsed = _timed(lambda: opt_hard.batch_remove_scenarios(
-        b_state, b_placement, b_meta, sets, num_candidates=512))
-    _emit("remove_broker_what_ifs_x64_2600brokers_1m_replicas_hard_goals",
-          elapsed, backend)
-
     # config #4: full default stack at north-star scale.
     opt_big = GoalOptimizer(goal_names=GOALS)
     elapsed = _timed(lambda: opt_big.optimizations(b_state, b_placement, b_meta))
     _emit("proposal_generation_wall_clock_2600brokers_1m_replicas_full_goals",
           elapsed, backend)
-    del b_state, b_placement, opt_big, opt_hard
+    del opt_big
+
+    # config #5: decommission what-ifs over a HEALTHY cluster (the realistic
+    # remove_broker setting — lanes pay for evacuation, not a full repair),
+    # one vmapped program per goal.  One timed call (compile included — the
+    # lane batch IS the amortization); the CPU fallback runs fewer lanes to
+    # keep the bench bounded.
+    del b_state, b_placement
+    healthy = rc.ClusterProperties(
+        num_brokers=2600, num_racks=40, num_topics=2000, num_replicas=1_000_000,
+        mean_cpu=0.002, mean_disk=60.0, mean_nw_in=60.0, mean_nw_out=60.0,
+        seed=3142)
+    h_state, h_placement, h_meta = rc.generate(healthy)
+    lanes = 64 if backend == "tpu" else 16
+    sets = [[b] for b in range(lanes)]
+    opt_hard = GoalOptimizer(goal_names=HARD_GOALS)
+    t0 = time.monotonic()
+    opt_hard.batch_remove_scenarios(h_state, h_placement, h_meta, sets,
+                                    num_candidates=512)
+    _emit("remove_broker_what_ifs_2600brokers_1m_replicas_hard_goals",
+          time.monotonic() - t0, backend, lanes=lanes, includes_compile=True)
+    del h_state, h_placement, opt_hard
 
     # Headline repeated LAST: the driver's artifact parser takes the tail line.
     _emit("proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",

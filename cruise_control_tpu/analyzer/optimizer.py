@@ -148,11 +148,18 @@ class GoalOptimizer:
         constraint: Optional[BalancingConstraint] = None,
         goal_names: Optional[Sequence[str]] = None,
         solver: Optional[GoalSolver] = None,
+        mesh=None,
     ):
         self.constraint = constraint or BalancingConstraint()
         self.goal_names = list(goal_names or DEFAULT_GOALS)
         if solver is not None:
             self.solver = solver
+        elif mesh is not None:
+            self.solver = GoalSolver(
+                max_candidates_per_round=self.constraint.max_candidates_per_round,
+                max_rounds_per_goal=self.constraint.max_rounds_per_goal,
+                mesh=mesh,
+            )
         elif (self.constraint.max_candidates_per_round == 4096
               and self.constraint.max_rounds_per_goal == 96):
             self.solver = default_solver()
@@ -191,6 +198,7 @@ class GoalOptimizer:
         goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
         t0 = time.monotonic()
         gctx = build_context(state, placement, meta, self.constraint, options)
+        gctx, placement = self.solver.shard_inputs(gctx, placement)
         initial = placement
 
         agg0 = compute_aggregates(gctx, placement)
@@ -208,8 +216,7 @@ class GoalOptimizer:
                            & np.asarray(state.broker_valid)).any())
         excl_move = np.asarray(gctx.excluded_for_replica_move)
         if excl_move.any():
-            held = np.bincount(np.asarray(placement.broker)[np.asarray(state.valid)],
-                               minlength=excl_move.shape[0])
+            held = np.asarray(agg0.replica_counts)
             has_broken = has_broken or bool((excl_move & (held > 0)).any())
 
         infos: List[GoalOptimizationInfo] = []
@@ -310,23 +317,35 @@ class GoalOptimizer:
 
         placement_s = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x[None], (s_n,) + x.shape), placement)
+        if self.solver.mesh is not None:
+            from cruise_control_tpu.parallel import (
+                replica_shardings,
+                scenario_shardings,
+            )
+            r_pad = state.num_replicas_padded
+            mesh = self.solver.mesh
+            gctx = jax.device_put(gctx, replica_shardings(mesh, gctx, r_pad))
+            lanes = (alive_j, excl_move_j, excl_lead_j, placement_s)
+            alive_j, excl_move_j, excl_lead_j, placement_s = jax.device_put(
+                lanes, scenario_shardings(mesh, lanes, r_pad, s_n))
 
-        g_n = len(goals)
-        violated = np.zeros((s_n, g_n), dtype=np.int64)
-        moves = np.zeros((s_n, g_n), dtype=np.int64)
-        rounds = np.zeros((s_n, g_n), dtype=np.int64)
-        stranded = np.zeros(s_n, dtype=np.int64)
+        # Keep per-goal outputs on device inside the loop — converting eagerly
+        # would synchronize each goal's execution with the next goal's trace/
+        # compile instead of pipelining them.
+        device_stats = []
         priors: List[Goal] = []
-        for gi, goal in enumerate(goals):
+        stranded_d = None
+        for goal in goals:
             batch = self.solver._batch_solve_fn(
                 goal, tuple(priors), state.num_replicas_padded, num_candidates)
             (placement_s, rounds_d, moves_d, violated_d, stranded_d,
              *_rest) = batch(gctx, alive_j, excl_move_j, excl_lead_j, placement_s)
-            violated[:, gi] = np.asarray(violated_d)
-            moves[:, gi] = np.asarray(moves_d)
-            rounds[:, gi] = np.asarray(rounds_d)
-            stranded = np.asarray(stranded_d)
+            device_stats.append((rounds_d, moves_d, violated_d))
             priors.append(goal)
+        rounds = np.stack([np.asarray(r) for r, _, _ in device_stats], axis=1)
+        moves = np.stack([np.asarray(m) for _, m, _ in device_stats], axis=1)
+        violated = np.stack([np.asarray(v) for _, _, v in device_stats], axis=1)
+        stranded = np.asarray(stranded_d)
 
         return BatchScenarioResult(
             removal_sets=[list(map(int, ids)) for ids in removal_sets],

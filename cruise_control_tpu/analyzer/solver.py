@@ -377,11 +377,26 @@ class GoalSolver:
 
     def __init__(self, max_candidates_per_round: int = 4096,
                  max_rounds_per_goal: int = 96,
-                 max_swap_candidates: int = 256):
+                 max_swap_candidates: int = 256,
+                 mesh=None):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self.max_swap_candidates = max_swap_candidates
+        # Optional jax.sharding.Mesh: inputs are committed with replica-axis
+        # shardings (parallel/mesh.py) and GSPMD partitions every solve —
+        # the multi-chip path (SURVEY §5).  None = single device.
+        self.mesh = mesh
         self._round_cache = {}
+
+    def shard_inputs(self, gctx: GoalContext, placement: Placement):
+        """Commit (gctx, placement) to this solver's mesh (no-op without one).
+        Call once per optimization; outputs stay sharded through the run."""
+        if self.mesh is None:
+            return gctx, placement
+        from cruise_control_tpu.parallel import replica_shardings
+        shardings = replica_shardings(self.mesh, (gctx, placement),
+                                      gctx.state.num_replicas_padded)
+        return jax.device_put((gctx, placement), shardings)
 
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []

@@ -1,0 +1,7 @@
+from cruise_control_tpu.parallel.mesh import (
+    make_solver_mesh,
+    replica_shardings,
+    scenario_shardings,
+)
+
+__all__ = ["make_solver_mesh", "replica_shardings", "scenario_shardings"]

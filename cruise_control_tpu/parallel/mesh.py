@@ -1,0 +1,75 @@
+"""Device-mesh construction and sharding rules for the solver.
+
+SURVEY §5: the reference scales a single-JVM solver by threads; the TPU
+design scales by sharding the REPLICA axis of the cluster tensors over a
+``jax.sharding.Mesh`` and letting XLA insert the collectives (segment-sums
+become psum-ed partial sums, top-k a sharded sort + gather) — the
+"annotate shardings, let the compiler partition" recipe.  A second mesh axis
+parallelizes independent what-if scenarios (the DP analog; BASELINE config
+#5's remove-broker batch).
+
+Everything here is shape-rule based: an array whose leading dimension equals
+the padded replica count is sharded over ``replica``; a lane-stacked array is
+sharded over ``scenario`` (and over ``replica`` in its second dimension when
+it stacks per-replica tensors); everything else is replicated.  Broker-axis
+aggregates stay replicated — they are O(B) and every phase reads them densely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SCENARIO_AXIS = "scenario"
+REPLICA_AXIS = "replica"
+
+
+def make_solver_mesh(num_devices: Optional[int] = None,
+                     scenario_parallelism: int = 1,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """2D mesh (scenario, replica).  ``scenario_parallelism`` devices are
+    dedicated to lane-parallel what-ifs; the rest shard the replica axis.
+    With the defaults the whole mesh shards replicas."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_devices if num_devices is not None else len(devs)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"make_solver_mesh({n}): only {len(devs)} devices visible — "
+            "if this is a virtual-CPU run, a JAX backend was initialized "
+            "before utils.hermetic.force_cpu(n) could take effect (call it "
+            "first, in a fresh process)")
+    devs = devs[:n]
+    if n % scenario_parallelism:
+        raise ValueError(f"{n} devices not divisible by "
+                         f"scenario_parallelism={scenario_parallelism}")
+    shape = (scenario_parallelism, n // scenario_parallelism)
+    return Mesh(mesh_utils.create_device_mesh(shape, devs),
+                axis_names=(SCENARIO_AXIS, REPLICA_AXIS))
+
+
+def _spec_for(arr, num_replicas_padded: int, lanes: Optional[int]) -> P:
+    shape = getattr(arr, "shape", ())
+    if lanes is not None and len(shape) >= 1 and shape[0] == lanes:
+        if len(shape) >= 2 and shape[1] == num_replicas_padded:
+            return P(SCENARIO_AXIS, REPLICA_AXIS)
+        return P(SCENARIO_AXIS)
+    if len(shape) >= 1 and shape[0] == num_replicas_padded:
+        return P(REPLICA_AXIS)
+    return P()
+
+
+def replica_shardings(mesh: Mesh, tree, num_replicas_padded: int):
+    """NamedSharding pytree: replica-leading arrays sharded, rest replicated."""
+    return jax.tree_util.tree_map(
+        lambda a: NamedSharding(mesh, _spec_for(a, num_replicas_padded, None)),
+        tree)
+
+
+def scenario_shardings(mesh: Mesh, tree, num_replicas_padded: int, lanes: int):
+    """NamedSharding pytree for lane-stacked arrays (what-if batches)."""
+    return jax.tree_util.tree_map(
+        lambda a: NamedSharding(mesh, _spec_for(a, num_replicas_padded, lanes)),
+        tree)
