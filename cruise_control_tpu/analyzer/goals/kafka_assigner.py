@@ -1,28 +1,267 @@
 """kafka-assigner emulation goals.
 
-Reference: ``analyzer/kafkaassigner/KafkaAssignerEvenRackAwareGoal.java`` and
-``KafkaAssignerDiskUsageDistributionGoal.java`` — legacy goal pair selected
-when a request carries ``kafka_assigner=true`` (RunnableUtils.isKafkaAssignerMode).
+Reference: ``analyzer/kafkaassigner/KafkaAssignerEvenRackAwareGoal.java``
+(position-even rack-aware placement: for every replica position p, each
+partition's position-p replica sits on the alive broker with the fewest
+position-p replicas among brokers whose rack holds no lower-position replica
+of that partition) and ``KafkaAssignerDiskUsageDistributionGoal.java``
+(disk balance across brokers achieved by SWAPPING replicas between broker
+pairs so replica counts never change).  The pair is selected when a request
+carries ``kafka_assigner=true`` (``RunnableUtils.java`` isKafkaAssignerMode).
 
-The even-rack goal's contract (replicas of a partition land on distinct racks,
-spread evenly by replica position) is the strict-rack invariant plus even
-spread — realised here as the relaxed-rack kernels with the strict cap; the
-disk goal is broker-level disk balance with the kafka-assigner's swap-style
-threshold semantics, which the shared solver covers via moves.
+TPU formulation: the reference's per-position TreeSet of (count, broker) and
+its one-replica-at-a-time pops become per-position count planes
+``i32[RF, B]`` (one segment-sum) with an even band
+``[floor(total_p/alive), ceil(total_p/alive)]``, and rack eligibility is the
+usual RF-wide sibling gather restricted to LOWER positions.  The shared
+batched solver then fills min-count brokers in parallel; the disk goal is the
+generic swap phase with replica moves disabled.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    GoalContext,
+    current_leader_of,
+    currently_offline,
+)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal,
+    NEG_INF,
+    OFFLINE_BONUS,
+    alive_mask,
+)
 from cruise_control_tpu.analyzer.goals.distribution import ResourceDistributionGoal
-from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
 from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import Placement
+
+_CONFLICT_BONUS = 1e6
 
 
-class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
+class KafkaAssignerEvenRackAwareGoal(Goal):
+    """Position-even, rack-aware placement (kafka-assigner mode, hard)."""
+
     name = "KafkaAssignerEvenRackAwareGoal"
     is_hard = True
+    src_sensitive_accept = True
+    # Position swaps: when a broker has excess leaders, transferring
+    # leadership to a follower on a leader-poor broker swaps the pair's
+    # positions (the reference's maybeApplyMove case 2 at position 0,
+    # KafkaAssignerEvenRackAwareGoal.java:192-201).
+    uses_leadership_moves = True
+
+    # ------------------------------------------------------------- plumbing
+
+    def _eff_pos(self, gctx: GoalContext, placement: Placement) -> jnp.ndarray:
+        """i32[R] effective replica position with the leader at 0.
+
+        The reference's STEP1 swaps the leader into list position 0
+        (KafkaAssignerEvenRackAwareGoal.java:115-120); here positions are
+        static snapshot data, so the swap is computed: the leader takes 0 and
+        the position-0 replica (if a follower) takes the leader's old slot.
+        """
+        state = gctx.state
+        lead = current_leader_of(gctx, placement, state.partition)     # [R]
+        lead_pos = jnp.where(lead >= 0, state.pos[jnp.maximum(lead, 0)], 0)
+        eff = jnp.where(placement.is_leader, 0,
+                        jnp.where((state.pos == 0) & (lead >= 0),
+                                  lead_pos, state.pos))
+        return jnp.clip(eff, 0, gctx.max_rf - 1)
+
+    def _pos_counts(self, gctx: GoalContext, placement: Placement,
+                    eff: jnp.ndarray) -> jnp.ndarray:
+        """i32[RF, B] valid-replica count per (position, broker)."""
+        b = gctx.state.num_brokers_padded
+        flat = eff * b + placement.broker
+        return jax.ops.segment_sum(
+            gctx.state.valid.astype(jnp.int32), flat,
+            num_segments=gctx.max_rf * b).reshape(gctx.max_rf, b)
+
+    def _bounds(self, gctx: GoalContext, counts: jnp.ndarray):
+        """(upper i32[RF], lower i32[RF]) even band per position."""
+        nb = jnp.maximum(jnp.sum(alive_mask(gctx)), 1)
+        total = jnp.sum(counts, axis=1)
+        upper = -(-total // nb)          # ceil
+        lower = total // nb
+        return upper, lower
+
+    def _rack_conflict(self, gctx: GoalContext, placement: Placement,
+                       eff: jnp.ndarray) -> jnp.ndarray:
+        """bool[R]: a LOWER-position sibling occupies this replica's rack."""
+        state = gctx.state
+        r = jnp.arange(state.num_replicas_padded)
+        sibs = gctx.partition_replicas[state.partition]                # [R, RF]
+        safe = jnp.maximum(sibs, 0)
+        is_sib = (sibs >= 0) & (sibs != r[:, None])
+        sib_rack = state.rack[placement.broker[safe]]
+        own = state.rack[placement.broker][:, None]
+        lower_pos = eff[safe] < eff[:, None]
+        return jnp.any(is_sib & lower_pos & (sib_rack == own), axis=-1) \
+            & state.valid
+
+    def _rack_eligible(self, gctx: GoalContext, placement: Placement,
+                       eff: jnp.ndarray, r, dst):
+        """bool: dst's rack holds no lower-position sibling of r (the
+        reference's ineligibleRackIds check, :166-172)."""
+        state = gctx.state
+        r = jnp.asarray(r)
+        sibs = gctx.partition_replicas[state.partition[r]]             # [...,RF]
+        safe = jnp.maximum(sibs, 0)
+        is_sib = (sibs >= 0) & (sibs != r[..., None])
+        sib_rack = state.rack[placement.broker[safe]]
+        lower_pos = eff[safe] < eff[r][..., None]
+        dst_rack = state.rack[jnp.asarray(dst)]
+        return ~jnp.any(is_sib & lower_pos
+                        & (sib_rack == dst_rack[..., None]), axis=-1)
+
+    def _rack_eligible_strict(self, gctx: GoalContext, placement: Placement,
+                              r, dst):
+        """bool: dst's rack holds NO sibling of r at all.  Used for the
+        acceptance vetoes over LATER goals' actions: once this goal has
+        finished, placements are rack-distinct, and a later move/swap must
+        not co-locate racks regardless of position (a lower-position-only
+        check is vacuous for position-0 replicas)."""
+        state = gctx.state
+        r = jnp.asarray(r)
+        sibs = gctx.partition_replicas[state.partition[r]]
+        safe = jnp.maximum(sibs, 0)
+        is_sib = (sibs >= 0) & (sibs != r[..., None])
+        sib_rack = state.rack[placement.broker[safe]]
+        dst_rack = state.rack[jnp.asarray(dst)]
+        return ~jnp.any(is_sib & (sib_rack == dst_rack[..., None]), axis=-1)
+
+    # --------------------------------------------------------------- rounds
+
+    def violated_brokers(self, gctx, placement, agg):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        over = jnp.any(counts > upper[:, None], axis=0) & alive_mask(gctx)
+        dead_with = ((~gctx.state.alive) & gctx.state.broker_valid
+                     & (agg.replica_counts > 0))
+        conflict = self._rack_conflict(gctx, placement, eff)
+        b = gctx.state.num_brokers_padded
+        conflict_b = jnp.zeros(b, dtype=bool).at[placement.broker].max(conflict)
+        return over | dead_with | conflict_b
+
+    def candidate_score(self, gctx, placement, agg):
+        state = gctx.state
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        over = counts[eff, placement.broker] > upper[eff]
+        conflict = self._rack_conflict(gctx, placement, eff)
+        offline = currently_offline(gctx, placement)
+        cand = (over | conflict) & state.valid & ~gctx.replica_excluded
+        # Leaders (position 0) first, like the reference's ascending-position
+        # sweep; rack conflicts outrank plain over-counts.
+        prio = (-eff.astype(jnp.float32)
+                + jnp.where(conflict, _CONFLICT_BONUS, 0.0))
+        score = jnp.where(cand, prio, NEG_INF)
+        return jnp.where(offline, prio + OFFLINE_BONUS, score)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        r = jnp.asarray(r)
+        count_ok = counts[eff[r], dst] + 1 <= upper[eff[r]]
+        # Offline/conflicted replicas may exceed the band rather than strand.
+        must_move = (currently_offline(gctx, placement, r)
+                     | self._rack_conflict(gctx, placement, eff)[r])
+        return (count_ok | must_move) & self._rack_eligible(
+            gctx, placement, eff, r, dst)
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        """Fewest position-p replicas first (the reference's TreeSet order)."""
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        return counts[eff[jnp.asarray(r)], dst].astype(jnp.float32)
+
+    # ----------------------------------------------------- leadership phase
+
+    def leadership_candidate_score(self, gctx, placement, agg):
+        """Followers whose leader sits on a leader-rich broker and who sit on
+        a leader-poor broker themselves."""
+        state = gctx.state
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        lead = current_leader_of(gctx, placement, state.partition)
+        lead_b = placement.broker[jnp.maximum(lead, 0)]
+        over = counts[0, lead_b] > upper[0]
+        own = placement.broker
+        cand = ((lead >= 0) & over & ~placement.is_leader & state.valid
+                & ~currently_offline(gctx, placement) & ~gctx.replica_excluded)
+        return jnp.where(cand, -counts[0, own].astype(jnp.float32), NEG_INF)
+
+    def leadership_self_ok(self, gctx, placement, agg, f):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        b = placement.broker[jnp.asarray(f)]
+        return counts[0, b] + 1 <= upper[0]
+
+    def accept_leadership_move(self, gctx, placement, agg, f):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        b = placement.broker[jnp.asarray(f)]
+        return counts[0, b] + 1 <= upper[0]
+
+    # --------------------------------------------------- acceptance (vetoes)
+
+    def accept_replica_move(self, gctx, placement, agg, r, dst):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        r = jnp.asarray(r)
+        return ((counts[eff[r], dst] + 1 <= upper[eff[r]])
+                & self._rack_eligible_strict(gctx, placement, r, dst))
+
+    def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
+        """Same-position swaps are count-neutral; cross-position swaps shift
+        one count each way.  Rack eligibility applies in both directions."""
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, lower = self._bounds(gctx, counts)
+        r_out = jnp.asarray(r_out)
+        r_in = jnp.asarray(r_in)
+        p_out, p_in = eff[r_out], eff[r_in]
+        same = p_out == p_in
+        counts_ok = ((counts[p_out, b_in] + 1 <= upper[p_out])
+                     & (counts[p_in, b_out] + 1 <= upper[p_in])
+                     & (counts[p_out, b_out] - 1 >= lower[p_out])
+                     & (counts[p_in, b_in] - 1 >= lower[p_in]))
+        return ((same | counts_ok)
+                & self._rack_eligible_strict(gctx, placement, r_out, b_in)
+                & self._rack_eligible_strict(gctx, placement, r_in, b_out))
+
+    def stats_metric(self, gctx, placement, agg):
+        eff = self._eff_pos(gctx, placement)
+        counts = self._pos_counts(gctx, placement, eff)
+        upper, _ = self._bounds(gctx, counts)
+        excess = jnp.maximum(counts - upper[:, None], 0).sum()
+        conflicts = jnp.sum(self._rack_conflict(gctx, placement, eff))
+        return (excess + conflicts).astype(jnp.float32)
 
 
 class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
+    """Disk balance via replica SWAPS only (kafka-assigner mode).
+
+    The reference (KafkaAssignerDiskUsageDistributionGoal.java:84-233) sorts
+    brokers by disk utilization and swaps replicas between the most- and
+    least-utilized pairs until both ends fall inside
+    ``mean ± balance-margin``; counts never change.  Here that is the shared
+    batched swap phase with the move/pull/leadership phases disabled.
+    """
+
+    uses_replica_moves = False
+    has_pull_phase = False
+    has_swap_phase = True
+
     def __init__(self):
         super().__init__(Resource.DISK, "KafkaAssignerDiskUsageDistributionGoal")

@@ -106,6 +106,14 @@ DEFAULT_HARD_GOALS: List[str] = [
 # config/cruisecontrol.properties:214.
 DEFAULT_ANOMALY_DETECTION_GOALS: List[str] = list(DEFAULT_HARD_GOALS)
 
+# RunnableUtils.java isKafkaAssignerMode: the pair swapped in when a request
+# carries kafka_assigner=true (even goal MUST run first — it assumes no prior
+# optimized goals, KafkaAssignerEvenRackAwareGoal.java:108-111).
+KAFKA_ASSIGNER_GOALS: List[str] = [
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
+]
+
 # config/cruisecontrol.properties:105.
 DEFAULT_INTRA_BROKER_GOALS: List[str] = [
     "IntraBrokerDiskCapacityGoal",

@@ -243,11 +243,14 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
                 & _group_winners(order, gain_b, b)
                 & _group_winners(order, lose_b, b))
 
-        is_leader = placement.is_leader
-        is_leader = is_leader.at[cand].set(
-            jnp.where(keep, True, is_leader[cand]))
-        is_leader = is_leader.at[old_safe].set(
-            jnp.where(keep, False, is_leader[old_safe]))
+        # Non-kept rows scatter to an out-of-range dummy (mode='drop'): their
+        # old_safe values repeat across rows (every non-candidate/padded row
+        # gathers SOME partition's leader), and a stale write would clobber
+        # the kept row's demotion (duplicate-index set is last-write-wins).
+        dummy = state.num_replicas_padded
+        is_leader = (placement.is_leader
+                     .at[jnp.where(keep, cand, dummy)].set(True, mode="drop")
+                     .at[jnp.where(keep, old_safe, dummy)].set(False, mode="drop"))
         placement = placement.replace(is_leader=is_leader)
         applied = jnp.sum(keep.astype(jnp.int32))
         agg = compute_aggregates(gctx, placement)

@@ -65,8 +65,22 @@ def _ints(params: Dict[str, str], name: str) -> List[int]:
 
 
 def _goals(params: Dict[str, str]) -> Optional[List[str]]:
+    """Requested goal list; ``kafka_assigner=true`` swaps in the assigner
+    pair (reference RunnableUtils.java isKafkaAssignerMode), honoring an
+    explicit assigner-goal subset and rejecting non-assigner goals (the
+    reference's sanityCheckOptimizationOptions)."""
     raw = params.get("goals", "")
     names = [g.strip().rsplit(".", 1)[-1] for g in raw.split(",") if g.strip()]
+    if _bool(params, "kafka_assigner", False):
+        from cruise_control_tpu.analyzer.goals.registry import KAFKA_ASSIGNER_GOALS
+        if not names:
+            return list(KAFKA_ASSIGNER_GOALS)
+        bad = [n for n in names if n not in KAFKA_ASSIGNER_GOALS]
+        if bad:
+            raise UserRequestError(
+                f"goals {bad} are not kafka_assigner goals "
+                f"(allowed: {KAFKA_ASSIGNER_GOALS})")
+        return names
     return names or None
 
 
@@ -132,7 +146,10 @@ class CruiseControlApp:
         handler = getattr(self, f"_ep_{endpoint}", None)
         if handler is None:
             return 501, {"error": f"{endpoint} not implemented"}, {}
-        return handler(params, task_id)
+        try:
+            return handler(params, task_id)
+        except UserRequestError as e:
+            return 400, {"error": str(e)}, {}
 
     # ---- sync GETs
 

@@ -1,0 +1,98 @@
+"""kafka-assigner mode tests.
+
+Mirrors the reference's ``KafkaAssignerEvenRackAwareGoalTest`` /
+``KafkaAssignerDiskUsageDistributionGoalTest`` behavior contracts:
+position-even counts + per-partition rack distinctness for the even goal,
+count-preserving swap-only disk balance for the disk goal, and the
+``kafka_assigner=true`` request-path switch (RunnableUtils.java).
+"""
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import GoalOptimizer
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import ops
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.testing import deterministic as det
+
+
+def _clumped_cluster():
+    """4 brokers / 2 racks; 8 RF=2 partitions all packed onto rack 0."""
+    cm = det.homogeneous_cluster({0: 0, 1: 0, 2: 1, 3: 1})
+    for p in range(8):
+        cm.create_replica("T1", p, broker_id=0, index=0, is_leader=True)
+        cm.create_replica("T1", p, broker_id=1, index=1, is_leader=False)
+        cm.set_replica_load("T1", p, 0, det.load(1.0, 5.0, 3.0, 10.0))
+        cm.set_replica_load("T1", p, 1, det.load(0.2, 5.0, 0.0, 10.0))
+    return cm.freeze(pad_replicas_to=64, pad_brokers_to=8)
+
+
+def test_even_rack_aware_goal():
+    state, placement, meta = _clumped_cluster()
+    opt = GoalOptimizer(goal_names=["KafkaAssignerEvenRackAwareGoal"])
+    res = opt.optimizations(state, placement, meta)
+    final = res.final_placement
+    valid = np.asarray(state.valid)
+    brokers = np.asarray(final.broker)[valid]
+    leaders = np.asarray(final.is_leader)[valid]
+    parts = np.asarray(state.partition)[valid]
+    racks = np.asarray(state.rack)
+
+    # Per-partition rack distinctness (RF=2 over 2 racks).
+    for p in np.unique(parts):
+        rows = parts == p
+        assert len(set(racks[brokers[rows]].tolist())) == 2, p
+
+    # Position-even: 8 leaders over 4 brokers -> 2 each; same for followers.
+    lead_counts = np.bincount(brokers[leaders], minlength=4)[:4]
+    foll_counts = np.bincount(brokers[~leaders], minlength=4)[:4]
+    assert lead_counts.max() - lead_counts.min() <= 1, lead_counts
+    assert foll_counts.max() - foll_counts.min() <= 1, foll_counts
+
+
+def test_even_rack_aware_evacuates_dead_broker():
+    cm = det.homogeneous_cluster({0: 0, 1: 0, 2: 1, 3: 1})
+    for p in range(6):
+        cm.create_replica("T1", p, broker_id=p % 4, index=0, is_leader=True)
+        cm.set_replica_load("T1", p, p % 4, det.load(1.0, 5.0, 3.0, 10.0))
+    cm.set_broker_state(3, alive=False)
+    state, placement, meta = cm.freeze(pad_replicas_to=64, pad_brokers_to=8)
+    opt = GoalOptimizer(goal_names=["KafkaAssignerEvenRackAwareGoal"])
+    res = opt.optimizations(state, placement, meta)
+    brokers = np.asarray(res.final_placement.broker)[np.asarray(state.valid)]
+    assert (brokers != 3).all()
+
+
+def _uneven_disk_cluster():
+    """Two brokers, equal counts, unequal disk: only swaps can balance."""
+    capacity = {Resource.CPU: det.TYPICAL_CPU_CAPACITY, Resource.NW_IN: 1000.0,
+                Resource.NW_OUT: det.MEDIUM_BROKER_CAPACITY, Resource.DISK: 20.0}
+    cm = det.homogeneous_cluster({0: 0, 1: 1}, capacity=capacity)
+    disk = {("T1", 0): (0, 10.0), ("T1", 1): (0, 8.0),
+            ("T2", 0): (1, 4.0), ("T2", 1): (1, 2.0)}
+    for (topic, part), (broker, value) in disk.items():
+        cm.create_replica(topic, part, broker_id=broker, index=0, is_leader=True)
+        cm.set_replica_load(topic, part, broker, det.load(1.0, 1.0, 0.0, value))
+    return cm.freeze(pad_replicas_to=64, pad_brokers_to=8)
+
+
+def test_kafka_assigner_disk_goal_swaps_only():
+    state, placement, meta = _uneven_disk_cluster()
+    opt = GoalOptimizer(goal_names=["KafkaAssignerDiskUsageDistributionGoal"])
+    res = opt.optimizations(state, placement, meta)
+    final = res.final_placement
+    bl = np.asarray(ops.broker_load(state, final))[:2, Resource.DISK]
+    cap = np.asarray(state.capacity)[:2, Resource.DISK]
+    avg = bl.sum() / cap.sum()
+    assert (bl <= avg * 1.1 * cap + 1e-4).all(), bl
+    counts = np.bincount(np.asarray(final.broker)[np.asarray(state.valid)],
+                         minlength=2)[:2]
+    assert counts.tolist() == [2, 2]
+
+
+def test_kafka_assigner_request_param():
+    from cruise_control_tpu.analyzer.goals.registry import KAFKA_ASSIGNER_GOALS
+    from cruise_control_tpu.servlet.server import _goals
+    assert _goals({"kafka_assigner": "true"}) == KAFKA_ASSIGNER_GOALS
+    assert _goals({"goals": "RackAwareGoal"}) == ["RackAwareGoal"]
+    assert _goals({}) is None
