@@ -89,6 +89,11 @@ def _monitor_def() -> ConfigDef:
     d.define("sample.store.class", ConfigType.CLASS, "")
     d.define("sample.store.dir", ConfigType.STRING, "")
     d.define("metric.sampler.class", ConfigType.CLASS, "")
+    # "synthetic" (default) | "reporter" (metrics-reporter pipeline through
+    # the transport) | "prometheus" — demo-mode sampler selection.
+    d.define("metric.sampler.mode", ConfigType.STRING, "synthetic")
+    d.define("num.metric.fetchers", ConfigType.INT, 4)
+    d.define("prometheus.server.endpoint", ConfigType.STRING, "")
     d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
              range_validator(0.0, 1.0))
     d.define("metadata.max.age.ms", ConfigType.LONG, 5_000)

@@ -66,9 +66,35 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
     )
     store_dir = config.get("sample.store.dir")
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
+    mode = config.get("metric.sampler.mode", "synthetic")
+    reporters = []
+    if mode == "reporter":
+        # Full ingestion edge: per-broker reporter agents → transport →
+        # fan-out consuming sampler (the metrics-reporter pipeline).
+        from cruise_control_tpu.monitor.fetcher import ConsumingMetricSampler
+        from cruise_control_tpu.reporter import (
+            DemoBrokerMetricsSource,
+            InProcessTransport,
+            MetricsReporter,
+        )
+        transport = InProcessTransport(num_partitions=8)
+        source = DemoBrokerMetricsSource(backend)
+        interval = config["metric.sampling.interval.ms"]
+        reporters = [MetricsReporter(b.broker_id, source, transport,
+                                     reporting_interval_ms=interval / 2)
+                     for b in backend.fetch().brokers]
+        sampler = ConsumingMetricSampler(
+            transport, num_fetchers=config["num.metric.fetchers"])
+    elif mode == "prometheus":
+        from cruise_control_tpu.monitor.prometheus import PrometheusMetricSampler
+        sampler = PrometheusMetricSampler(
+            endpoint=config["prometheus.server.endpoint"])
+    else:
+        sampler = SyntheticWorkloadSampler()
     task_runner = LoadMonitorTaskRunner(
-        load_monitor, SyntheticWorkloadSampler(), store,
+        load_monitor, sampler, store,
         sampling_interval_ms=config["metric.sampling.interval.ms"])
+    task_runner.reporters = reporters
     executor = Executor(FakeClusterBackend(backend),
                         config.executor_config())
     notifier = SelfHealingNotifier(

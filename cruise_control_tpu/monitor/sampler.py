@@ -142,15 +142,32 @@ class CruiseControlMetricsProcessor:
 
 # ---------------------------------------------------------- synthetic source
 
+# Shared demo-workload constants + jitter, used by BOTH the synthetic sampler
+# and the reporter pipeline's DemoBrokerMetricsSource so the two demo modes
+# produce comparable load shapes.
+DEMO_MEAN_BYTES_IN = 1000.0
+DEMO_MEAN_BYTES_OUT = 800.0
+DEMO_MEAN_SIZE = 5000.0
+DEMO_CPU_PER_LEADER = 0.4
+DEMO_SEED = 7
+
+
+def synthetic_jitter(key, seed: int = DEMO_SEED) -> float:
+    """Deterministic per-entity workload jitter in [0.8, 1.2)."""
+    rng = np.random.default_rng((hash(key) ^ seed) & 0x7FFFFFFF)
+    return 0.8 + 0.4 * rng.random()
+
 
 class SyntheticWorkloadSampler:
     """Deterministic workload generator behind the MetricSampler SPI —
     the in-process stand-in for the metrics-reporter + Kafka pipeline
     (plays the role the embedded-broker harness plays in reference tests)."""
 
-    def __init__(self, mean_bytes_in: float = 1000.0, mean_bytes_out: float = 800.0,
-                 mean_size: float = 5000.0, cpu_per_partition: float = 0.4,
-                 seed: int = 7):
+    def __init__(self, mean_bytes_in: float = DEMO_MEAN_BYTES_IN,
+                 mean_bytes_out: float = DEMO_MEAN_BYTES_OUT,
+                 mean_size: float = DEMO_MEAN_SIZE,
+                 cpu_per_partition: float = DEMO_CPU_PER_LEADER,
+                 seed: int = DEMO_SEED):
         self.mean_bytes_in = mean_bytes_in
         self.mean_bytes_out = mean_bytes_out
         self.mean_size = mean_size
@@ -164,9 +181,7 @@ class SyntheticWorkloadSampler:
         for p in metadata.partitions:
             if p.leader is None:
                 continue
-            rng = np.random.default_rng(
-                (hash((p.topic, p.partition)) ^ self.seed) & 0x7FFFFFFF)
-            jitter = 0.8 + 0.4 * rng.random()
+            jitter = synthetic_jitter((p.topic, p.partition), self.seed)
             ps = PartitionMetricSample(broker_id=p.leader, topic=p.topic,
                                        partition=p.partition)
             ps.record(md.CPU_USAGE, self.cpu_per_partition * jitter)

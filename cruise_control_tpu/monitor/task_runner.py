@@ -11,6 +11,7 @@ vectorized ingest (dense-array adds make per-partition fetch threads moot).
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 import time
 from typing import Optional
@@ -18,6 +19,8 @@ from typing import Optional
 from cruise_control_tpu.monitor.load_monitor import LoadMonitor
 from cruise_control_tpu.monitor.sampler import MetricSampler, SamplerResult
 from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+
+LOG = logging.getLogger(__name__)
 
 
 class RunnerState(enum.Enum):
@@ -50,6 +53,9 @@ class LoadMonitorTaskRunner:
         self._paused_reason: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
         self._last_sampling_ms: float = 0.0
+        # Optional broker-side reporter agents (metrics-reporter pipeline) —
+        # started/stopped with the runner.
+        self.reporters: list = []
 
     # ----------------------------------------------------------- lifecycle
 
@@ -68,11 +74,15 @@ class LoadMonitorTaskRunner:
         with self._lock:
             if self._state is RunnerState.LOADING:
                 self._state = RunnerState.RUNNING
+        for reporter in self.reporters:
+            reporter.start()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="sampling-task")
         self._thread.start()
 
     def shutdown(self) -> None:
+        for reporter in self.reporters:
+            reporter.stop()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -97,7 +107,12 @@ class LoadMonitorTaskRunner:
             now = self._clock() * 1000
             if now - self._last_sampling_ms < self.sampling_interval_ms_effective():
                 continue
-            self.run_sampling_once(now)
+            try:
+                self.run_sampling_once(now)
+            except Exception:   # noqa: BLE001 — a transient fetch failure
+                # (network-bound samplers: prometheus down, transport IO)
+                # must not kill the sampling thread; skip the tick and retry.
+                LOG.warning("sampling tick failed; will retry", exc_info=True)
 
     def sampling_interval_ms_effective(self) -> float:
         return self.sampling_interval_s * 1000.0

@@ -1,0 +1,22 @@
+from cruise_control_tpu.reporter.agent import (
+    BrokerMetricsSource,
+    DemoBrokerMetricsSource,
+    MetricsReporter,
+)
+from cruise_control_tpu.reporter.serde import (
+    METRIC_VERSION,
+    UnknownVersionError,
+    deserialize_metric,
+    serialize_metric,
+)
+from cruise_control_tpu.reporter.transport import (
+    FileTransport,
+    InProcessTransport,
+    Transport,
+)
+
+__all__ = [
+    "BrokerMetricsSource", "DemoBrokerMetricsSource", "MetricsReporter",
+    "METRIC_VERSION", "UnknownVersionError", "deserialize_metric",
+    "serialize_metric", "FileTransport", "InProcessTransport", "Transport",
+]

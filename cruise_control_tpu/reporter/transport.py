@@ -1,0 +1,98 @@
+"""Pluggable transport between reporter agents and the monitor.
+
+Reference: the reporter publishes serialized metrics to the
+``__CruiseControlMetrics`` Kafka topic (CruiseControlMetricsReporter.java:
+producer setup :160-180, send :340-360) and samplers consume it partitioned.
+Here the transport is an SPI with the same shape — append records to a
+numbered partition, poll a partition range since an offset — so the
+in-process demo, a file-backed queue, or a real message bus all fit behind
+the fetch fan-out's partition assignor.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import List, Protocol, Sequence, Tuple
+
+_LEN = struct.Struct(">I")
+
+
+class Transport(Protocol):
+    @property
+    def num_partitions(self) -> int: ...
+
+    def append(self, partition: int, record: bytes) -> None: ...
+
+    def poll(self, partition: int, offset: int,
+             max_records: int = 10_000) -> Tuple[List[bytes], int]:
+        """(records, next_offset) from ``offset`` onward."""
+        ...
+
+
+class InProcessTransport:
+    """Partitioned in-memory log (the demo/test bus)."""
+
+    def __init__(self, num_partitions: int = 8):
+        self._parts: List[List[bytes]] = [[] for _ in range(num_partitions)]
+        self._lock = threading.Lock()
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def append(self, partition: int, record: bytes) -> None:
+        with self._lock:
+            self._parts[partition % len(self._parts)].append(record)
+
+    def poll(self, partition: int, offset: int,
+             max_records: int = 10_000) -> Tuple[List[bytes], int]:
+        with self._lock:
+            log = self._parts[partition % len(self._parts)]
+            out = log[offset:offset + max_records]
+            return list(out), offset + len(out)
+
+
+class FileTransport:
+    """Partitioned length-prefixed segment files (durable demo bus)."""
+
+    def __init__(self, directory: str, num_partitions: int = 8):
+        self._dir = directory
+        self._n = num_partitions
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    @property
+    def num_partitions(self) -> int:
+        return self._n
+
+    def _path(self, partition: int) -> str:
+        return os.path.join(self._dir, f"metrics-{partition % self._n}.log")
+
+    def append(self, partition: int, record: bytes) -> None:
+        with self._lock, open(self._path(partition), "ab") as f:
+            f.write(_LEN.pack(len(record)))
+            f.write(record)
+
+    def poll(self, partition: int, offset: int,
+             max_records: int = 10_000) -> Tuple[List[bytes], int]:
+        """``offset`` is a BYTE offset for the file transport."""
+        path = self._path(partition)
+        if not os.path.exists(path):
+            return [], offset
+        out: List[bytes] = []
+        with self._lock, open(path, "rb") as f:
+            f.seek(offset)
+            pos = offset
+            while len(out) < max_records:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    break
+                (n,) = _LEN.unpack(head)
+                rec = f.read(n)
+                if len(rec) < n:   # torn tail write — re-read next poll
+                    break
+                out.append(rec)
+                pos = f.tell()
+            return out, pos

@@ -80,7 +80,9 @@ def _goals(params: Dict[str, str]) -> Optional[List[str]]:
             raise UserRequestError(
                 f"goals {bad} are not kafka_assigner goals "
                 f"(allowed: {KAFKA_ASSIGNER_GOALS})")
-        return names
+        # Canonical order: the even goal must run before the disk goal (it
+        # assumes no prior optimized goals).
+        return [g for g in KAFKA_ASSIGNER_GOALS if g in names]
     return names or None
 
 

@@ -1,0 +1,200 @@
+"""Ingestion-edge tests: reporter agent → wire serde → transport → fan-out
+consuming sampler → processor, plus the Prometheus sampler and the full
+reporter-mode service pipeline (reporter → aggregator → snapshot → solver).
+
+Reference models: MetricSerdeTest, CruiseControlMetricsReporterTest (sans
+embedded broker), MetricFetcherManagerTest, PrometheusMetricSamplerTest.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.monitor import metric_def as md
+from cruise_control_tpu.monitor.fetcher import (
+    ConsumingMetricSampler,
+    DefaultMetricSamplerPartitionAssignor,
+)
+from cruise_control_tpu.monitor.metadata import (
+    BrokerInfo,
+    FakeMetadataBackend,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.prometheus import (
+    PrometheusMetricSampler,
+    PrometheusSeries,
+)
+from cruise_control_tpu.monitor.samples import (
+    CruiseControlMetric,
+    RawMetricScope,
+    RawMetricType,
+    broker_metric_types_for_version,
+)
+from cruise_control_tpu.reporter import (
+    DemoBrokerMetricsSource,
+    FileTransport,
+    InProcessTransport,
+    MetricsReporter,
+    UnknownVersionError,
+    deserialize_metric,
+    serialize_metric,
+)
+
+
+def _backend(num_brokers=3, num_partitions=9, rf=2):
+    brokers = [BrokerInfo(i, rack=str(i % 2), host=f"host{i}")
+               for i in range(num_brokers)]
+    parts = [PartitionInfo("T1", p, leader=p % num_brokers,
+                           replicas=tuple((p + i) % num_brokers for i in range(rf)),
+                           in_sync=tuple((p + i) % num_brokers for i in range(rf)))
+             for p in range(num_partitions)]
+    return FakeMetadataBackend(brokers, parts)
+
+
+def test_serde_round_trip_all_types():
+    for t in RawMetricType:
+        m = CruiseControlMetric(
+            raw_type=t, time_ms=123_456.0, broker_id=7,
+            topic="T1" if t.scope is not RawMetricScope.BROKER else None,
+            partition=3 if t.scope is RawMetricScope.PARTITION else None,
+            value=42.5)
+        got = deserialize_metric(serialize_metric(m))
+        assert got == m, t
+
+
+def test_serde_rejects_newer_version_and_skips_unknown_type():
+    m = CruiseControlMetric(raw_type=RawMetricType.ALL_TOPIC_BYTES_IN,
+                            time_ms=1.0, broker_id=0, value=1.0)
+    buf = bytearray(serialize_metric(m))
+    newer = bytes([buf[0] + 1]) + bytes(buf[1:])
+    with pytest.raises(UnknownVersionError):
+        deserialize_metric(newer)
+    unknown_type = bytes([buf[0], 200]) + bytes(buf[2:])
+    assert deserialize_metric(unknown_type) is None
+
+
+def test_raw_type_inventory_matches_reference():
+    assert len(RawMetricType) == 63
+    assert RawMetricType.PARTITION_SIZE.wire_id == 4
+    assert RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH.wire_id == 62
+    v4 = broker_metric_types_for_version(4)
+    v5 = broker_metric_types_for_version(5)
+    assert len(v5) - len(v4) == 20   # the 20 percentile types arrive in v5
+
+
+def test_reporter_emits_full_inventory():
+    backend = _backend()
+    transport = InProcessTransport(num_partitions=4)
+    rep = MetricsReporter(0, DemoBrokerMetricsSource(backend), transport,
+                          clock=lambda: 1000.0)
+    n = rep.report_once()
+    assert n > 60
+    records, _ = transport.poll(0, 0, max_records=100_000)
+    types = {deserialize_metric(r).raw_type for r in records if deserialize_metric(r)}
+    broker_types = {t for t in types if t.scope is RawMetricScope.BROKER}
+    assert broker_types == {t for t in RawMetricType
+                            if t.scope is RawMetricScope.BROKER}
+
+
+def test_partition_assignor_round_robin():
+    sets = DefaultMetricSamplerPartitionAssignor.assign(8, 3)
+    assert sets == [[0, 3, 6], [1, 4, 7], [2, 5]]
+    assert DefaultMetricSamplerPartitionAssignor.assign(2, 4) == [[0], [1], [], []]
+
+
+def _report_all(backend, transport, time_ms):
+    source = DemoBrokerMetricsSource(backend)
+    for b in backend.fetch().brokers:
+        MetricsReporter(b.broker_id, source, transport,
+                        clock=lambda: time_ms).report_once()
+
+
+def test_consuming_sampler_end_to_end():
+    backend = _backend()
+    transport = InProcessTransport(num_partitions=4)
+    _report_all(backend, transport, 5_000.0)
+    sampler = ConsumingMetricSampler(transport, num_fetchers=3)
+    result = sampler.get_samples(backend.fetch(), 0.0, 10_000.0)
+    assert len(result.broker_samples) == 3
+    assert len(result.partition_samples) == 9
+    ps = result.partition_samples[0]
+    assert ps.metrics[md.DISK_USAGE] > 0
+    assert ps.metrics[md.LEADER_BYTES_IN] > 0
+    # Offsets advanced: a second poll round returns nothing new.
+    again = sampler.get_samples(backend.fetch(), 0.0, 10_000.0)
+    assert not again.partition_samples
+
+
+def test_file_transport_round_trip(tmp_path):
+    transport = FileTransport(str(tmp_path), num_partitions=2)
+    backend = _backend()
+    _report_all(backend, transport, 5_000.0)
+    sampler = ConsumingMetricSampler(transport, num_fetchers=2)
+    result = sampler.get_samples(backend.fetch(), 0.0, 10_000.0)
+    assert len(result.broker_samples) == 3
+    assert len(result.partition_samples) == 9
+
+
+def test_prometheus_sampler_with_fake_adapter():
+    backend = _backend()
+    meta = backend.fetch()
+
+    def query_fn(promql, start_ms, end_ms):
+        out = []
+        if "topic, partition" in promql:              # partition-size query
+            for p in meta.partitions:
+                out.append(PrometheusSeries(
+                    labels={"instance": f"host{p.leader}:9092", "topic": p.topic,
+                            "partition": str(p.partition)},
+                    values=[(end_ms / 1000, 5000.0)]))
+        elif "topic" in promql:                       # per-topic queries
+            for b in meta.brokers:
+                out.append(PrometheusSeries(
+                    labels={"instance": f"host{b.broker_id}:9092", "topic": "T1"},
+                    values=[(end_ms / 1000, 900.0)]))
+        else:                                         # broker-scope queries
+            for b in meta.brokers:
+                out.append(PrometheusSeries(
+                    labels={"instance": f"host{b.broker_id}:9092"},
+                    values=[(end_ms / 1000, 0.4), (end_ms / 1000 + 60, 0.6)]))
+            # A series from a foreign cluster must be skipped, not fatal.
+            out.append(PrometheusSeries(labels={"instance": "other:9092"},
+                                        values=[(end_ms / 1000, 1.0)]))
+        return out
+
+    sampler = PrometheusMetricSampler(query_fn=query_fn)
+    result = sampler.get_samples(meta, 0.0, 120_000.0)
+    assert len(result.broker_samples) == 3
+    assert len(result.partition_samples) == 9
+    bdef = md.BROKER_METRIC_DEF
+    bs = result.broker_samples[0]
+    assert bs.metrics[bdef.metric_id("CPU_USAGE")] == pytest.approx(0.5)
+
+
+def test_reporter_mode_service_pipeline():
+    """Full path: reporter agents → transport → fan-out sampler → windows →
+    snapshot → solver, through the real service bootstrap."""
+    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+    from cruise_control_tpu.main import build_app
+
+    cfg = CruiseControlConfig({
+        "metric.sampler.mode": "reporter",
+        "metric.sampling.interval.ms": 200,
+        "partition.metrics.window.ms": 400,
+        "num.metric.fetchers": 3,
+    })
+    app = build_app(cfg, demo=True, port=0)
+    app.cc.start_up()
+    try:
+        import time
+        deadline = time.time() + 60
+        result = None
+        while time.time() < deadline:
+            try:
+                result = app.cc.proposals()
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert result is not None, "proposals never became available"
+        assert result.optimizer_result.stats_after is not None
+    finally:
+        app.cc.shutdown()
