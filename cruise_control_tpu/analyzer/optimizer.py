@@ -197,6 +197,8 @@ class GoalOptimizer:
 
         goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
         t0 = time.monotonic()
+        from cruise_control_tpu.common.metrics import registry
+        proposal_timer = registry().timer("GoalOptimizer.proposal-computation-timer")
         gctx = build_context(state, placement, meta, self.constraint, options)
         gctx, placement = self.solver.shard_inputs(gctx, placement)
         initial = placement
@@ -219,6 +221,12 @@ class GoalOptimizer:
             held = np.asarray(agg0.replica_counts)
             has_broken = has_broken or bool((excl_move & (held > 0)).any())
 
+        # Provision gauges (AnomalyDetectorManager.java:173-192): a hard-goal
+        # optimization failure marks the cluster under-provisioned.
+        prov_under = registry().settable_gauge("AnomalyDetector.under-provisioned")
+        prov_right = registry().settable_gauge("AnomalyDetector.right-sized")
+        registry().settable_gauge("AnomalyDetector.over-provisioned")
+
         infos: List[GoalOptimizationInfo] = []
         priors: List[Goal] = []
         for goal in goals:
@@ -231,10 +239,17 @@ class GoalOptimizer:
                 from cruise_control_tpu.analyzer.context import currently_offline
                 stranded = int(np.sum(np.asarray(
                     currently_offline(gctx, placement))))
-            check_hard_goal(goal, info, stranded)
+            try:
+                check_hard_goal(goal, info, stranded)
+            except OptimizationFailureError:
+                prov_under.set(1)
+                prov_right.set(0)
+                raise
             worsened = (info.rounds > 0 and info.metric_after
                         > info.metric_before * (1 + 1e-5) + 1e-9)
             if worsened and not has_broken:
+                prov_under.set(1)
+                prov_right.set(0)
                 raise OptimizationFailureError(
                     f"[{goal.name}] optimized result is worse than before: "
                     f"{info.metric_before:.6g} -> {info.metric_after:.6g}")
@@ -243,6 +258,8 @@ class GoalOptimizer:
                             "%.6g -> %.6g", goal.name,
                             info.metric_before, info.metric_after)
             priors.append(goal)
+        prov_under.set(0)
+        prov_right.set(1)
 
         aggN = compute_aggregates(gctx, placement)
         violated_after = [
@@ -263,6 +280,9 @@ class GoalOptimizer:
             elapsed_s=time.monotonic() - t0,
             final_placement=placement,
         )
+        proposal_timer.update_ms(result.elapsed_s * 1000.0)
+        registry().settable_gauge("AnomalyDetector.balancedness-score").set(
+            result.balancedness_score)
         if cache_key is not None:
             with self._cache_lock:
                 self._cached = {cache_key: result}   # keep only latest generation

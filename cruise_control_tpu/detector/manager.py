@@ -64,6 +64,32 @@ class AnomalyDetectorManager:
         self._threads: List[threading.Thread] = []
         self.state = AnomalyState()
         self._check_later: List[tuple] = []   # (due_monotonic_s, anomaly)
+        self._anomaly_detected_s: Dict[int, float] = {}
+        self._register_sensors()
+
+    def _register_sensors(self) -> None:
+        """AnomalyDetector sensors (Sensors.md;
+        AnomalyDetectorManager.java:173-192)."""
+        from cruise_control_tpu.common.metrics import registry
+        reg = registry()
+        self._rate_counters = {
+            t: reg.counter(f"AnomalyDetector.{t.name.lower()}-rate")
+            for t in self.detectors
+        }
+        self._self_healing_started = reg.counter(
+            "AnomalyDetector.number-of-self-healing-started")
+        self._fix_start_timer = reg.timer(
+            "AnomalyDetector.mean-time-to-start-fix-ms")
+        for t in self.detectors:
+            reg.gauge(
+                f"AnomalyDetector.{t.name.lower()}-self-healing-enabled",
+                (lambda tt: lambda: int(bool(
+                    self.notifier.self_healing_enabled().get(tt, False)
+                    if hasattr(self.notifier, "self_healing_enabled") else False)))(t))
+        reg.gauge("AnomalyDetector.has-ongoing-self-healing",
+                  lambda: int(self.state.ongoing_self_healing is not None))
+        reg.gauge("AnomalyDetector.anomaly-queue-size",
+                  lambda: len(self._queue))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -109,7 +135,11 @@ class AnomalyDetectorManager:
     def _enqueue(self, anomaly: Anomaly) -> None:
         with self._qlock:
             heapq.heappush(self._queue, anomaly)
+        counter = self._rate_counters.get(anomaly.anomaly_type)
+        if counter is not None:
+            counter.inc()
         self.state.record(anomaly, "DETECTED")
+        self._anomaly_detected_s.setdefault(id(anomaly), self._clock())
 
     # ------------------------------------------------------------- handling
 
@@ -138,6 +168,9 @@ class AnomalyDetectorManager:
     def _handle(self, anomaly: Anomaly) -> None:
         action = self.notifier.on_anomaly(anomaly)
         if action.result is AnomalyNotificationResult.IGNORE:
+            # Drop the detection timestamp too: id() can be reused after GC
+            # and a stale entry would poison mean-time-to-start-fix.
+            self._anomaly_detected_s.pop(id(anomaly), None)
             self.state.record(anomaly, "IGNORED")
             return
         if action.result is AnomalyNotificationResult.CHECK:
@@ -148,6 +181,10 @@ class AnomalyDetectorManager:
             return
         # FIX
         self.state.ongoing_self_healing = anomaly.anomaly_type.name
+        self._self_healing_started.inc()
+        detected = self._anomaly_detected_s.pop(id(anomaly), None)
+        if detected is not None:
+            self._fix_start_timer.update_ms((self._clock() - detected) * 1000.0)
         try:
             ok = False
             if anomaly.fix is not None:

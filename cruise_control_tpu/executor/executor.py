@@ -100,6 +100,43 @@ class Executor:
         self._pause_sampling: Optional[Callable[[], None]] = None
         self._resume_sampling: Optional[Callable[[], None]] = None
         self._generating_proposals_for_execution = False
+        self._register_sensors()
+
+    def _register_sensors(self) -> None:
+        """Executor sensors (Sensors.md; Executor.java:259-275 caps)."""
+        from cruise_control_tpu.common.metrics import registry
+        from cruise_control_tpu.executor.tasks import (
+            ExecutionTaskState as S,
+            TaskType as T,
+        )
+        reg = registry()
+
+        def task_count(task_type, state):
+            def read():
+                return self.tracker.summary().get(task_type.value, {}).get(
+                    state.value, 0)
+            return read
+
+        for kind, t in (("replica", T.INTER_BROKER_REPLICA_ACTION),
+                        ("leadership", T.LEADER_ACTION)):
+            for sname, s in (("in-progress", S.IN_PROGRESS),
+                             ("pending", S.PENDING),
+                             ("aborting", S.ABORTING),
+                             ("aborted", S.ABORTED),
+                             ("dead", S.DEAD)):
+                reg.gauge(f"Executor.{kind}-action-{sname}", task_count(t, s))
+        reg.gauge("Executor.ongoing-execution",
+                  lambda: int(self.has_ongoing_execution))
+        reg.gauge("Executor.inter-broker-partition-movements-per-broker-cap",
+                  lambda: self.adjuster.current)
+        reg.gauge("Executor.intra-broker-partition-movements-per-broker-cap",
+                  lambda: self.config.concurrent_intra_broker_partition_movements)
+        reg.gauge("Executor.leadership-movements-global-cap",
+                  lambda: self.config.concurrent_leader_movements)
+        self._sensor_started = reg.counter("Executor.execution-started")
+        self._sensor_stopped = reg.counter("Executor.execution-stopped")
+        self._sensor_stopped_by_user = reg.counter(
+            "Executor.execution-stopped-by-user")
 
     # ------------------------------------------------------------- wiring
 
@@ -160,18 +197,24 @@ class Executor:
             total = min(len(proposals), self.config.max_num_cluster_movements)
             for t in self._planner.add_proposals(list(proposals)[:total]):
                 self.tracker.add(t)
+        self._sensor_started.inc()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="proposal-execution")
         self._thread.start()
         if wait:
             self._thread.join()
 
-    def user_triggered_stop_execution(self) -> None:
-        """Executor.userTriggeredStopExecution :782."""
+    def user_triggered_stop_execution(self, user: bool = True) -> None:
+        """Executor.userTriggeredStopExecution :782 (``user=False`` for
+        service-initiated stops, e.g. self-healing preemption — the
+        execution-stopped / execution-stopped-by-user sensors diverge)."""
         with self._lock:
             if self.has_ongoing_execution:
                 self._state = ExecutorState.STOPPING_EXECUTION
                 self._stop_requested.set()
+                self._sensor_stopped.inc()
+                if user:
+                    self._sensor_stopped_by_user.inc()
 
     # ---------------------------------------------------------- internals
 

@@ -64,6 +64,9 @@ class MetricSampleCompleteness:
     num_entities: int
     num_valid_entities: int
     generation: int = 0
+    # Valid entities that needed extrapolation for at least one window
+    # (Sensors.md num-partitions-with-extrapolations).
+    num_valid_entities_with_extrapolations: int = 0
 
 
 @dataclass
@@ -353,7 +356,9 @@ class MetricSampleAggregator:
                 valid_entity_ratio=ratio, valid_entity_group_ratio=gratio,
                 valid_windows=windows, num_entities=e_n,
                 num_valid_entities=int(entity_valid.sum()),
-                generation=self._generation)
+                generation=self._generation,
+                num_valid_entities_with_extrapolations=int(
+                    (entity_valid & (num_extrapolated > 0)).sum()))
             if ratio < options.min_valid_entity_ratio:
                 raise NotEnoughValidWindowsError(
                     f"valid entity ratio {ratio:.3f} < "

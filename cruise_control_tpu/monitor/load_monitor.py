@@ -98,6 +98,44 @@ class LoadMonitor:
         self._model_semaphore = threading.BoundedSemaphore(
             max_concurrent_model_generations)
         self._resource_matrix = md.COMMON_METRIC_DEF.resource_matrix()
+        self._register_sensors()
+
+    def _register_sensors(self) -> None:
+        """LoadMonitor sensors (Sensors.md: valid-windows,
+        total-monitored-windows, monitored-partitions-percentage, num-topics,
+        num-partitions-with-extrapolations, cluster-model-creation-timer).
+
+        Completeness runs one full aggregation pass — a scrape samples five
+        gauges, so the result is cached for a few seconds instead of being
+        recomputed per gauge."""
+        from cruise_control_tpu.common.metrics import registry
+        reg = registry()
+        cache = {"at": 0.0, "value": None}
+        cache_lock = threading.Lock()
+
+        def completeness():
+            now = time.monotonic()
+            with cache_lock:
+                if cache["value"] is None or now - cache["at"] > 5.0:
+                    cache["value"] = self.partition_aggregator.completeness(
+                        -float("inf"), time.time() * 1000)
+                    cache["at"] = now
+                return cache["value"]
+
+        reg.gauge("LoadMonitor.valid-windows",
+                  lambda: len(completeness().valid_windows))
+        reg.gauge("LoadMonitor.total-monitored-windows",
+                  lambda: self.partition_aggregator.num_available_windows())
+        reg.gauge("LoadMonitor.monitored-partitions-percentage",
+                  lambda: round(completeness().valid_entity_ratio * 100.0, 3))
+        reg.gauge("LoadMonitor.num-valid-partitions",
+                  lambda: completeness().num_valid_entities)
+        reg.gauge("LoadMonitor.num-partitions-with-extrapolations",
+                  lambda: completeness().num_valid_entities_with_extrapolations)
+        reg.gauge("LoadMonitor.num-topics",
+                  lambda: len({p.topic for p in
+                               self.metadata_client.cluster().partitions}))
+        self._model_timer = reg.timer("LoadMonitor.cluster-model-creation-timer")
 
     # ---------------------------------------------------------- generation
 
@@ -151,7 +189,7 @@ class LoadMonitor:
         """Build a frozen snapshot (LoadMonitor.clusterModel :530-582)."""
         requirements = requirements or ModelCompletenessRequirements()
         to_ms = time.time() * 1000 if to_ms is None else to_ms
-        with self.acquire_for_model_generation():
+        with self.acquire_for_model_generation(), self._model_timer.time():
             metadata = self.metadata_client.refresh_metadata()
             options = AggregationOptions(
                 min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,

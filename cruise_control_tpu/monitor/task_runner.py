@@ -127,7 +127,19 @@ class LoadMonitorTaskRunner:
             now_ms = self._clock() * 1000 if now_ms is None else now_ms
             start = self._last_sampling_ms or (now_ms - self.sampling_interval_s * 1000)
             metadata = self.load_monitor.metadata_client.refresh_metadata()
-            result = self.sampler.get_samples(metadata, start, now_ms)
+            # MetricFetcherManager sensors (Sensors.md): per-round fetch
+            # timer + failure rate.
+            from cruise_control_tpu.common.metrics import registry
+            reg = registry()
+            try:
+                with reg.timer(
+                        "MetricFetcherManager.partition-samples-fetcher-timer"
+                ).time():
+                    result = self.sampler.get_samples(metadata, start, now_ms)
+            except Exception:
+                reg.counter("MetricFetcherManager."
+                            "partition-samples-fetcher-failure-rate").inc()
+                raise
             n = self._ingest(result)
             self._last_sampling_ms = now_ms
             return n

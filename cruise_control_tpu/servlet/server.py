@@ -38,7 +38,8 @@ URL_PREFIX = "/kafkacruisecontrol/"
 USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
-                 "state", "kafka_cluster_state", "user_tasks", "review_board"}
+                 "state", "kafka_cluster_state", "user_tasks", "review_board",
+                 "metrics"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -148,10 +149,23 @@ class CruiseControlApp:
         handler = getattr(self, f"_ep_{endpoint}", None)
         if handler is None:
             return 501, {"error": f"{endpoint} not implemented"}, {}
+        # Per-endpoint servlet sensors (Sensors.md: <endpoint>-request-rate,
+        # <endpoint>-successful-request-execution-timer).
+        from cruise_control_tpu.common.metrics import registry
+        reg = registry()
+        reg.counter(f"KafkaCruiseControlServlet.{endpoint}-request-rate").inc()
+        import time as _time
+        t0 = _time.monotonic()
         try:
-            return handler(params, task_id)
+            status, body, headers = handler(params, task_id)
         except UserRequestError as e:
             return 400, {"error": str(e)}, {}
+        if status < 400:
+            reg.timer(
+                f"KafkaCruiseControlServlet.{endpoint}"
+                "-successful-request-execution-timer"
+            ).update_ms((_time.monotonic() - t0) * 1000.0)
+        return status, body, headers
 
     # ---- sync GETs
 
@@ -163,6 +177,13 @@ class CruiseControlApp:
 
     def _ep_load(self, params, task_id):
         return 200, self.cc.broker_stats(), {}
+
+    def _ep_metrics(self, params, task_id):
+        """Sensor surface: JSON snapshot (?json=true) or Prometheus text."""
+        from cruise_control_tpu.common.metrics import registry
+        if _bool(params, "json", False):
+            return 200, {"sensors": registry().snapshot()}, {}
+        return 200, registry().prometheus_text(), {}
 
     def _ep_partition_load(self, params, task_id):
         n = int(params.get("entries", "100"))
@@ -388,14 +409,20 @@ def _make_handler(app: CruiseControlApp):
                 LOG.exception("request failed")
                 status, payload, headers = 500, {
                     "error": type(e).__name__, "message": str(e)}, {}
-            payload.setdefault("version", 1)
+            if isinstance(payload, dict):
+                payload.setdefault("version", 1)
             self._send(status, payload, headers)
 
         def _send(self, status: int, payload: Dict,
                   headers: Optional[Dict[str, str]] = None):
-            body = json.dumps(payload).encode()
+            if isinstance(payload, str):      # text endpoints (/metrics)
+                body = payload.encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                body = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)

@@ -62,6 +62,19 @@ class UserTaskManager:
         self._lock = threading.Lock()
         self.max_active = max_active_tasks
         self.retention_ms = completed_retention_ms
+        from cruise_control_tpu.common.metrics import registry
+
+        def _active():
+            with self._lock:
+                return sum(1 for t in self._tasks.values()
+                           if t.state is TaskState.ACTIVE)
+
+        def _total():
+            with self._lock:
+                return len(self._tasks)
+
+        registry().gauge("UserTaskManager.num-active-user-tasks", _active)
+        registry().gauge("UserTaskManager.num-user-tasks", _total)
 
     def submit(self, endpoint: str, query: str,
                operation: Callable[[OperationProgress], Any],
