@@ -133,6 +133,15 @@ def _webserver_def() -> ConfigDef:
     d.define("webserver.api.urlprefix", ConfigType.STRING, "/kafkacruisecontrol/*")
     d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000)
     d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 21_600_000)
+    # Security (reference WebServerConfig.WEBSERVER_SECURITY_*):
+    d.define("webserver.security.enable", ConfigType.BOOLEAN, False)
+    # "basic" | "jwt" | "trusted_proxy"
+    d.define("webserver.security.provider", ConfigType.STRING, "basic")
+    d.define("webserver.auth.credentials.file", ConfigType.STRING, "")
+    d.define("webserver.auth.jwt.secret", ConfigType.STRING, "")
+    d.define("webserver.auth.trusted.proxy.ips", ConfigType.STRING, "")
+    d.define("webserver.auth.trusted.proxy.user.header", ConfigType.STRING,
+             "X-Forwarded-User")
     d.define("max.active.user.tasks", ConfigType.INT, 25)
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG, 86_400_000)
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False)

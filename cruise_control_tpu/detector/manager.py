@@ -135,11 +135,15 @@ class AnomalyDetectorManager:
     def _enqueue(self, anomaly: Anomaly) -> None:
         with self._qlock:
             heapq.heappush(self._queue, anomaly)
-        counter = self._rate_counters.get(anomaly.anomaly_type)
-        if counter is not None:
-            counter.inc()
-        self.state.record(anomaly, "DETECTED")
+        # Count only first-time detections: CHECK-delayed anomalies re-enter
+        # through this path and must not inflate the detection-rate sensor.
+        first_time = id(anomaly) not in self._anomaly_detected_s
         self._anomaly_detected_s.setdefault(id(anomaly), self._clock())
+        if first_time:
+            counter = self._rate_counters.get(anomaly.anomaly_type)
+            if counter is not None:
+                counter.inc()
+        self.state.record(anomaly, "DETECTED")
 
     # ------------------------------------------------------------- handling
 

@@ -116,8 +116,34 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
         host=config["webserver.http.address"],
         port=port if port is not None else config["webserver.http.port"],
         two_step_verification=config["two.step.verification.enabled"],
-        max_active_user_tasks=config["max.active.user.tasks"])
+        max_active_user_tasks=config["max.active.user.tasks"],
+        security=_security_provider(config))
     return app
+
+
+def _security_provider(config: CruiseControlConfig):
+    """webserver.security.* → provider instance (None when disabled)."""
+    if not config["webserver.security.enable"]:
+        return None
+    from cruise_control_tpu.servlet import security as sec
+    kind = config["webserver.security.provider"]
+    if kind == "basic":
+        return sec.BasicSecurityProvider(
+            credentials_file=config["webserver.auth.credentials.file"] or None)
+    if kind == "jwt":
+        secret = config["webserver.auth.jwt.secret"]
+        if not secret:
+            raise ValueError("webserver.auth.jwt.secret required for jwt provider")
+        return sec.JwtSecurityProvider(secret)
+    if kind == "trusted_proxy":
+        ips = [s.strip() for s in
+               config["webserver.auth.trusted.proxy.ips"].split(",") if s.strip()]
+        if not ips:
+            raise ValueError("webserver.auth.trusted.proxy.ips required for "
+                             "the trusted_proxy provider")
+        return sec.TrustedProxySecurityProvider(
+            ips, user_header=config["webserver.auth.trusted.proxy.user.header"])
+    raise ValueError(f"unknown webserver.security.provider {kind!r}")
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,212 @@
+"""Response schemas + a minimal JSON-schema checker.
+
+Reference: ``cruise-control/src/yaml/{endpoints,responses}/**`` — OpenAPI
+response schemas — and the ``ResponseTest`` pattern that validates live
+endpoint payloads against them in CI.  The subset of JSON Schema used by
+those files (type/properties/required/items/enum) is implemented here with
+the stdlib so schema checks can run inside the server tests (and optionally
+at serving time for debugging).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cruise_control_tpu.common.exceptions import CruiseControlError
+
+
+class SchemaViolation(CruiseControlError):
+    pass
+
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def _type_ok(value: Any, expected: str) -> bool:
+    if expected == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if expected == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, _TYPES[expected])
+
+
+def validate(value: Any, schema: Dict, path: str = "$") -> None:
+    """Raise SchemaViolation on the first mismatch."""
+    expected = schema.get("type")
+    if expected is not None:
+        types = expected if isinstance(expected, list) else [expected]
+        if not any(_type_ok(value, t) for t in types):
+            raise SchemaViolation(
+                f"{path}: expected {expected}, got {type(value).__name__}")
+    if "enum" in schema and value not in schema["enum"]:
+        raise SchemaViolation(f"{path}: {value!r} not in {schema['enum']}")
+    if isinstance(value, dict):
+        for key in schema.get("required", []):
+            if key not in value:
+                raise SchemaViolation(f"{path}: missing required key {key!r}")
+        props = schema.get("properties", {})
+        for key, sub in props.items():
+            if key in value:
+                validate(value[key], sub, f"{path}.{key}")
+        extra = schema.get("additionalProperties")
+        if isinstance(extra, dict):
+            for key, v in value.items():
+                if key not in props:
+                    validate(v, extra, f"{path}.{key}")
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            validate(item, schema["items"], f"{path}[{i}]")
+
+
+# ------------------------------------------------------- endpoint schemas
+
+_TIMER = {"type": "object"}
+
+STATE_SCHEMA = {
+    "type": "object",
+    "required": ["MonitorState", "ExecutorState", "AnalyzerState",
+                 "AnomalyDetectorState", "version"],
+    "properties": {
+        "MonitorState": {
+            "type": "object",
+            "required": ["state", "numValidWindows",
+                         "monitoredPartitionsPercentage"],
+            "properties": {
+                "state": {"type": "string"},
+                "numValidWindows": {"type": "integer"},
+                "monitoredPartitionsPercentage": {"type": "number"},
+            },
+        },
+        "ExecutorState": {
+            "type": "object",
+            "required": ["state"],
+            "properties": {"state": {"type": "string"}},
+        },
+        "AnalyzerState": {"type": "object"},
+        "AnomalyDetectorState": {"type": "object"},
+        "version": {"type": "integer"},
+    },
+}
+
+_STAT_ROW = {
+    "type": "object",
+    "required": ["cpu", "networkInbound", "networkOutbound", "disk"],
+    "properties": {k: {"type": "number"} for k in
+                   ("cpu", "networkInbound", "networkOutbound", "disk",
+                    "replicas")},
+}
+
+LOAD_SCHEMA = {
+    "type": "object",
+    "required": ["statistics", "numBrokers", "numReplicas", "numLeaders",
+                 "version"],
+    "properties": {
+        "statistics": {
+            "type": "object",
+            "required": ["AVG", "MAX", "MIN", "STD"],
+            "properties": {k: _STAT_ROW for k in ("AVG", "MAX", "MIN", "STD")},
+        },
+        "numBrokers": {"type": "integer"},
+        "numReplicas": {"type": "integer"},
+        "numLeaders": {"type": "integer"},
+        "version": {"type": "integer"},
+    },
+}
+
+PARTITION_LOAD_SCHEMA = {
+    "type": "object",
+    "required": ["records", "version"],
+    "properties": {
+        "records": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["topic", "partition", "cpu", "networkInbound",
+                             "networkOutbound", "disk"],
+                "properties": {
+                    "topic": {"type": "string"},
+                    "partition": {"type": "integer"},
+                    "cpu": {"type": "number"},
+                    "networkInbound": {"type": "number"},
+                    "networkOutbound": {"type": "number"},
+                    "disk": {"type": "number"},
+                },
+            },
+        },
+    },
+}
+
+OPERATION_RESULT_SCHEMA = {
+    "type": "object",
+    "required": ["dryrun", "executed", "result", "version"],
+    "properties": {
+        "dryrun": {"type": "boolean"},
+        "executed": {"type": "boolean"},
+        "result": {
+            "type": "object",
+            "required": ["numLeaderMovements", "violatedGoalsBefore",
+                         "violatedGoalsAfter", "goals"],
+            "properties": {
+                "numLeaderMovements": {"type": "integer"},
+                "violatedGoalsBefore": {"type": "array",
+                                        "items": {"type": "string"}},
+                "violatedGoalsAfter": {"type": "array",
+                                       "items": {"type": "string"}},
+                "balancednessScore": {"type": "number"},
+                "goals": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["goal", "violatedBrokersBefore",
+                                     "violatedBrokersAfter"],
+                    },
+                },
+            },
+        },
+    },
+}
+
+USER_TASKS_SCHEMA = {
+    "type": "object",
+    "required": ["userTasks", "version"],
+    "properties": {
+        "userTasks": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["UserTaskId", "Status", "RequestURL", "StartMs"],
+                "properties": {
+                    "UserTaskId": {"type": "string"},
+                    "Status": {"type": "string"},
+                    "RequestURL": {"type": "string"},
+                    "StartMs": {"type": "integer"},
+                },
+            },
+        },
+    },
+}
+
+KAFKA_CLUSTER_STATE_SCHEMA = {
+    "type": "object",
+    "required": ["KafkaBrokerState", "KafkaPartitionState", "version"],
+    "properties": {
+        "KafkaBrokerState": {"type": "object"},
+        "KafkaPartitionState": {"type": "object"},
+    },
+}
+
+ENDPOINT_SCHEMAS: Dict[str, Dict] = {
+    "state": STATE_SCHEMA,
+    "load": LOAD_SCHEMA,
+    "partition_load": PARTITION_LOAD_SCHEMA,
+    "proposals": OPERATION_RESULT_SCHEMA,
+    "rebalance": OPERATION_RESULT_SCHEMA,
+    "user_tasks": USER_TASKS_SCHEMA,
+    "kafka_cluster_state": KAFKA_CLUSTER_STATE_SCHEMA,
+}

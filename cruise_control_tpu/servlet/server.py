@@ -9,6 +9,12 @@ Implementation: stdlib ThreadingHTTPServer — the service is control-plane
 (tens of requests/min), so a dependency-free server keeps the runtime
 hermetic; the layering (app → façade → components) mirrors
 ``KafkaCruiseControlApp``.
+
+Endpoint inventory note: the mounted reference tree has no ``rightsize``
+endpoint (it post-dates this version; ``CruiseControlEndPoint.java`` lists
+20 endpoints without it), so it is intentionally absent here; the provision
+signals it would act on are exported as the AnomalyDetector
+under/over/right-sized gauges.
 """
 
 from __future__ import annotations
@@ -103,10 +109,14 @@ class CruiseControlApp:
 
     def __init__(self, cc: CruiseControl, host: str = "127.0.0.1", port: int = 0,
                  two_step_verification: bool = False,
-                 max_active_user_tasks: int = 25):
+                 max_active_user_tasks: int = 25,
+                 security=None):
         self.cc = cc
         self.user_tasks = UserTaskManager(max_active_tasks=max_active_user_tasks)
         self.purgatory = Purgatory() if two_step_verification else None
+        # Optional servlet security provider (servlet/security.py): when set,
+        # every request is authenticated and role-checked before dispatch.
+        self.security = security
         handler = _make_handler(self)
         self.server = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
@@ -390,6 +400,29 @@ def _make_handler(app: CruiseControlApp):
                 self._send(404, {"error": "not found"})
                 return
             endpoint = parsed.path[len(URL_PREFIX):].strip("/").lower()
+            if app.security is not None:
+                from cruise_control_tpu.servlet.security import (
+                    permits,
+                    required_role,
+                )
+                try:
+                    principal = app.security.authenticate(
+                        dict(self.headers), self.client_address[0])
+                except Exception:   # noqa: BLE001 — provider bug reads as 401
+                    LOG.exception("security provider failed")
+                    principal = None
+                if principal is None:
+                    self._send(401, {"error": "authentication required",
+                                     "version": 1},
+                               app.security.challenge())
+                    return
+                need = required_role(method, endpoint)
+                if not permits(principal.role, need):
+                    self._send(403, {
+                        "error": f"role {principal.role.value} may not access "
+                                 f"{method} {endpoint} (requires {need.value})",
+                        "version": 1}, {})
+                    return
             params = _parse_params(parsed.query)
             if method == "POST" and self.headers.get("Content-Length"):
                 body = self.rfile.read(int(self.headers["Content-Length"]))

@@ -1,0 +1,195 @@
+"""Security-provider and response-schema tests.
+
+Reference models: ``servlet/security/**`` (Basic/JWT/trusted-proxy, the
+DefaultRoleSecurityProvider role structure) and the ``ResponseTest`` pattern
+validating live endpoint payloads against the response schemas.
+"""
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.servlet.schemas import (
+    ENDPOINT_SCHEMAS,
+    SchemaViolation,
+    validate,
+)
+from cruise_control_tpu.servlet.security import (
+    BasicSecurityProvider,
+    JwtSecurityProvider,
+    Principal,
+    Role,
+    TrustedProxySecurityProvider,
+    make_jwt,
+    permits,
+    required_role,
+)
+
+
+def test_role_structure():
+    """DefaultRoleSecurityProvider.java:50-62."""
+    assert required_role("GET", "kafka_cluster_state") is Role.VIEWER
+    assert required_role("GET", "user_tasks") is Role.VIEWER
+    assert required_role("GET", "review_board") is Role.VIEWER
+    assert required_role("GET", "state") is Role.USER
+    assert required_role("GET", "proposals") is Role.USER
+    assert required_role("GET", "bootstrap") is Role.ADMIN
+    assert required_role("GET", "train") is Role.ADMIN
+    assert required_role("POST", "rebalance") is Role.ADMIN
+    assert permits(Role.ADMIN, Role.VIEWER)
+    assert not permits(Role.VIEWER, Role.USER)
+
+
+def _basic_header(user, password):
+    token = base64.b64encode(f"{user}:{password}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}
+
+
+def test_basic_provider(tmp_path):
+    creds = tmp_path / "realm.properties"
+    creds.write_text("admin: secret,ADMIN\nviewer: look,VIEWER\n# comment\n")
+    p = BasicSecurityProvider(credentials_file=str(creds))
+    assert p.authenticate(_basic_header("admin", "secret"), "1.2.3.4") == \
+        Principal("admin", Role.ADMIN)
+    assert p.authenticate(_basic_header("viewer", "look"), "x").role is Role.VIEWER
+    assert p.authenticate(_basic_header("admin", "wrong"), "x") is None
+    assert p.authenticate({}, "x") is None
+    assert "WWW-Authenticate" in p.challenge()
+
+
+def test_jwt_provider():
+    p = JwtSecurityProvider("s3cret")
+    token = make_jwt({"sub": "alice", "role": "USER",
+                      "exp": time.time() + 60}, "s3cret")
+    got = p.authenticate({"Authorization": f"Bearer {token}"}, "x")
+    assert got == Principal("alice", Role.USER)
+    expired = make_jwt({"sub": "alice", "role": "USER",
+                        "exp": time.time() - 1}, "s3cret")
+    assert p.authenticate({"Authorization": f"Bearer {expired}"}, "x") is None
+    forged = make_jwt({"sub": "alice", "role": "ADMIN"}, "other-secret")
+    assert p.authenticate({"Authorization": f"Bearer {forged}"}, "x") is None
+
+
+def test_trusted_proxy_provider():
+    p = TrustedProxySecurityProvider(["10.0.0.1"])
+    headers = {"X-Forwarded-User": "bob"}
+    assert p.authenticate(headers, "10.0.0.1") == Principal("bob", Role.ADMIN)
+    assert p.authenticate(headers, "10.0.0.2") is None
+    assert p.authenticate({}, "10.0.0.1") is None
+
+
+def test_schema_checker():
+    schema = {"type": "object", "required": ["a"],
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "array", "items": {"type": "string"}}}}
+    validate({"a": 1, "b": ["x"]}, schema)
+    with pytest.raises(SchemaViolation):
+        validate({"b": []}, schema)
+    with pytest.raises(SchemaViolation):
+        validate({"a": "nope"}, schema)
+    with pytest.raises(SchemaViolation):
+        validate({"a": 1, "b": [2]}, schema)
+
+
+@pytest.fixture(scope="module")
+def secured_app():
+    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+    from cruise_control_tpu.main import build_app
+    import tempfile, os
+    fd, path = tempfile.mkstemp(suffix=".properties")
+    with os.fdopen(fd, "w") as f:
+        f.write("admin: pw,ADMIN\nviewer: look,VIEWER\nuser: go,USER\n")
+    cfg = CruiseControlConfig({
+        "metric.sampling.interval.ms": 300,
+        "partition.metrics.window.ms": 600,
+        "webserver.security.enable": True,
+        "webserver.auth.credentials.file": path,
+    })
+    app = build_app(cfg, demo=True, port=0)
+    app.cc.start_up()
+    app.start()
+    yield app
+    app.stop()
+    app.cc.shutdown()
+    os.unlink(path)
+
+
+def _get(app, path, user=None, password=None, method="GET"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.port}/kafkacruisecontrol{path}", method=method)
+    if user:
+        token = base64.b64encode(f"{user}:{password}".encode()).decode()
+        req.add_header("Authorization", f"Basic {token}")
+    return urllib.request.urlopen(req)
+
+
+def test_secured_endpoints(secured_app):
+    app = secured_app
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(app, "/state")
+    assert e.value.code == 401
+    assert e.value.headers.get("WWW-Authenticate")
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(app, "/state", "viewer", "look")
+    assert e.value.code == 403
+
+    assert _get(app, "/state", "user", "go").status == 200
+    assert _get(app, "/kafka_cluster_state", "viewer", "look").status == 200
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(app, "/rebalance?dryrun=true", "user", "go", method="POST")
+    assert e.value.code == 403
+    # Admin is AUTHORIZED (the op itself may 500 until windows accumulate).
+    try:
+        code = _get(app, "/rebalance?dryrun=true", "admin", "pw",
+                    method="POST").status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code not in (401, 403), code
+
+
+def test_live_responses_match_schemas(secured_app):
+    """ResponseTest pattern: fetch each schema'd endpoint and validate."""
+    app = secured_app
+
+    def fetch_done(path, method="GET"):
+        # Per-endpoint budget: the first proposals/rebalance call compiles
+        # the full goal stack (~1 min on the CPU test backend).
+        deadline = time.time() + 150
+        task_id = None
+        while time.time() < deadline:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{app.port}/kafkacruisecontrol{path}",
+                method=method)
+            token = base64.b64encode(b"admin:pw").decode()
+            req.add_header("Authorization", f"Basic {token}")
+            if task_id:
+                req.add_header("User-Task-ID", task_id)
+            try:
+                r = urllib.request.urlopen(req)
+            except urllib.error.HTTPError:
+                time.sleep(0.5)
+                continue
+            task_id = r.headers.get("User-Task-ID", task_id)
+            body = json.load(r)
+            if "progress" not in body:
+                return body
+            time.sleep(0.5)
+        raise AssertionError(f"{path} never completed")
+
+    for endpoint, path, method in (
+        ("state", "/state", "GET"),
+        ("load", "/load", "GET"),
+        ("partition_load", "/partition_load", "GET"),
+        ("kafka_cluster_state", "/kafka_cluster_state", "GET"),
+        ("user_tasks", "/user_tasks", "GET"),
+        ("proposals", "/proposals", "GET"),
+        ("rebalance", "/rebalance?dryrun=true", "POST"),
+    ):
+        body = fetch_done(path, method)
+        validate(body, ENDPOINT_SCHEMAS[endpoint])
