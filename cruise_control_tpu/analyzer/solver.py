@@ -154,7 +154,8 @@ def _src_sensitive(goal: Goal, priors: Sequence[Goal]) -> bool:
 
 def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                    score_fn: Callable, self_ok_fn: Callable,
-                   dst_mask_fn: Optional[Callable] = None):
+                   dst_mask_fn: Optional[Callable] = None,
+                   jitter_frac: float = 1.0):
     """One conflict-free batched replica-move phase:
     (gctx, placement, agg) -> (placement, agg, applied)."""
     accept = _chain_accept_replica(priors)
@@ -185,7 +186,8 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         ranked = jnp.argsort(proxy).astype(jnp.int32)        # cheap → expensive
         assign = ranked[jnp.arange(c, dtype=jnp.int32) % b]
         ok_assign = jnp.take_along_axis(ok, assign[:, None], axis=1)[:, 0]
-        jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2), _INF_COST)
+        jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2,
+                                        frac=jitter_frac), _INF_COST)
         fallback = jnp.argmin(jcost, axis=1).astype(jnp.int32)
         dst = jnp.where(ok_assign, assign, fallback)
         feasible = jnp.any(ok, axis=1) & is_cand
@@ -381,10 +383,16 @@ class GoalSolver:
     def __init__(self, max_candidates_per_round: int = 4096,
                  max_rounds_per_goal: int = 96,
                  max_swap_candidates: int = 256,
-                 mesh=None):
+                 mesh=None,
+                 dst_jitter_frac: float = 1.0):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self.max_swap_candidates = max_swap_candidates
+        # Destination-jitter span as a fraction of each candidate's feasible
+        # cost range.  1.0 maximizes batch width (fast convergence); 0.0 is
+        # pure greedy argmin (narrow batches).  The measured trade-off is
+        # asserted in tests/test_quality_breadth.py::test_jitter_frac_sweep.
+        self.dst_jitter_frac = dst_jitter_frac
         # Optional jax.sharding.Mesh: inputs are committed with replica-axis
         # shardings (parallel/mesh.py) and GSPMD partitions every solve —
         # the multi-chip path (SURVEY §5).  None = single device.
@@ -414,11 +422,13 @@ class GoalSolver:
             phases.append(_leadership_phase(goal, priors, c))
         if goal.uses_replica_moves:
             phases.append(_replica_phase(goal, priors, c,
-                                         goal.candidate_score, goal.self_ok))
+                                         goal.candidate_score, goal.self_ok,
+                                         jitter_frac=self.dst_jitter_frac))
         if goal.has_pull_phase:
             phases.append(_replica_phase(goal, priors, c,
                                          goal.pull_candidate_score, goal.self_ok,
-                                         dst_mask_fn=goal.pull_dst_mask))
+                                         dst_mask_fn=goal.pull_dst_mask,
+                                         jitter_frac=self.dst_jitter_frac))
         if goal.has_swap_phase:
             # Swap pairs are C×C; keep the tile small — swaps are the
             # last-resort mechanism, a few per round suffice.

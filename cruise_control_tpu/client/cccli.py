@@ -22,6 +22,101 @@ from typing import Dict, List, Optional, Tuple
 USER_TASK_HEADER = "User-Task-ID"
 
 
+def _bool_param(raw: str) -> str:
+    v = raw.strip().lower()
+    if v not in ("true", "false", "1", "0", "yes", "no"):
+        raise ValueError(f"expected a boolean, got {raw!r}")
+    return "true" if v in ("true", "1", "yes") else "false"
+
+
+def _int_param(raw: str) -> str:
+    int(raw)          # raises ValueError with context via argparse
+    return raw.strip()
+
+
+def _pos_int_param(raw: str) -> str:
+    if int(raw) <= 0:
+        raise ValueError(f"expected a positive integer, got {raw!r}")
+    return raw.strip()
+
+
+def _float_param(raw: str) -> str:
+    float(raw)
+    return raw.strip()
+
+
+def _csv_int_param(raw: str) -> str:
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("expected a comma-separated id list")
+    for p in parts:
+        int(p)
+    return ",".join(parts)
+
+
+def _csv_str_param(raw: str) -> str:
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("expected a comma-separated list")
+    return ",".join(parts)
+
+
+def _str_param(raw: str) -> str:
+    return raw
+
+
+_ANOMALY_TYPES = ("broker_failure", "goal_violation", "disk_failure",
+                  "metric_anomaly", "topic_anomaly", "maintenance_event")
+
+
+def _anomaly_type_param(raw: str) -> str:
+    """CSV of anomaly types (the server accepts a list)."""
+    parts = [p.strip().lower() for p in raw.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("expected at least one anomaly type")
+    for p in parts:
+        if p not in _ANOMALY_TYPES:
+            raise ValueError(f"expected one of {_ANOMALY_TYPES}, got {p!r}")
+    return ",".join(parts)
+
+
+# Typed parameter registry (the reference's CCParameter classes —
+# cruise-control-client/.../client/CCParameter/* — one validator per
+# parameter; bad values are rejected client-side before any HTTP).
+PARAMETERS: Dict[str, "Parameter"] = {}
+
+
+@dataclass(frozen=True)
+class Parameter:
+    name: str
+    validator: "object"
+    help: str = ""
+
+    def __post_init__(self):
+        PARAMETERS[self.name] = self
+
+
+Parameter("verbose", _bool_param, "include verbose sections")
+Parameter("entries", _pos_int_param, "max records returned")
+Parameter("goals", _csv_str_param, "comma-separated goal names")
+Parameter("excluded_topics", _csv_str_param, "topics to leave untouched")
+Parameter("dryrun", _bool_param, "propose only, do not execute")
+Parameter("kafka_assigner", _bool_param, "use kafka-assigner mode goals")
+Parameter("destination_broker_ids", _csv_int_param, "allowed destinations")
+Parameter("brokerid", _csv_int_param, "target broker id(s)")
+Parameter("start", _float_param, "range start (ms)")
+Parameter("end", _float_param, "range end (ms)")
+Parameter("topic", _str_param, "topic name")
+Parameter("replication_factor", _pos_int_param, "target replication factor")
+Parameter("reason", _str_param, "free-form reason")
+Parameter("approve", _csv_int_param, "review id(s) to approve")
+Parameter("discard", _csv_int_param, "review id(s) to discard")
+Parameter("enable_self_healing_for", _anomaly_type_param, "anomaly type")
+Parameter("disable_self_healing_for", _anomaly_type_param, "anomaly type")
+Parameter("concurrent_partition_movements_per_broker", _pos_int_param,
+          "executor concurrency cap")
+
+
 @dataclass(frozen=True)
 class EndpointSpec:
     """One REST endpoint: method + the parameters it accepts
@@ -31,6 +126,10 @@ class EndpointSpec:
     method: str
     params: Tuple[str, ...] = ()
     help: str = ""
+
+    def __post_init__(self):
+        unknown = [p for p in self.params if p not in PARAMETERS]
+        assert not unknown, f"{self.name}: unregistered parameters {unknown}"
 
 
 ENDPOINTS: Dict[str, EndpointSpec] = {e.name: e for e in [
@@ -45,7 +144,8 @@ ENDPOINTS: Dict[str, EndpointSpec] = {e.name: e for e in [
     EndpointSpec("bootstrap", "GET", ("start", "end"), "re-ingest sample range"),
     EndpointSpec("train", "GET", ("start", "end"), "train the CPU model"),
     EndpointSpec("rebalance", "POST", ("dryrun", "goals", "excluded_topics",
-                                       "destination_broker_ids"), "rebalance"),
+                                       "destination_broker_ids",
+                                       "kafka_assigner"), "rebalance"),
     EndpointSpec("add_broker", "POST", ("brokerid", "dryrun", "goals"),
                  "move load onto new brokers"),
     EndpointSpec("remove_broker", "POST", ("brokerid", "dryrun", "goals"),
@@ -73,10 +173,12 @@ class Responder:
     """HTTP with 202 progress polling (client/Responder.py semantics)."""
 
     def __init__(self, base_url: str, poll_interval_s: float = 0.5,
-                 max_wait_s: float = 600.0):
+                 max_wait_s: float = 600.0,
+                 auth_header: Optional[str] = None):
         self.base = base_url.rstrip("/")
         self.poll_interval_s = poll_interval_s
         self.max_wait_s = max_wait_s
+        self.auth_header = auth_header
 
     def request(self, spec: EndpointSpec, params: Dict[str, str]) -> Dict:
         qs = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
@@ -87,6 +189,8 @@ class Responder:
         deadline = time.time() + self.max_wait_s
         while True:
             req = urllib.request.Request(url, method=spec.method)
+            if self.auth_header:
+                req.add_header("Authorization", self.auth_header)
             if task_id:
                 req.add_header(USER_TASK_HEADER, task_id)
             try:
@@ -108,6 +212,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpucc", description="TPU-native Cruise Control client")
     parser.add_argument("-a", "--address", default="http://127.0.0.1:9090",
                         help="server base URL")
+    parser.add_argument("--username", default=None,
+                        help="HTTP Basic username (secured servers)")
+    parser.add_argument("--password", default=None,
+                        help="HTTP Basic password")
+    parser.add_argument("--token", default=None,
+                        help="Bearer token (JWT-secured servers)")
     sub = parser.add_subparsers(dest="command")
     sub.required = False
 
@@ -122,7 +232,11 @@ def build_parser() -> argparse.ArgumentParser:
     for spec in ENDPOINTS.values():
         p = sub.add_parser(spec.name, help=spec.help)
         for param in spec.params:
-            p.add_argument(f"--{param}", default=None)
+            meta = PARAMETERS[param]
+            # argparse runs the validator and reports ValueError as a
+            # clean usage error — no malformed value ever reaches the wire.
+            p.add_argument(f"--{param}", default=None, type=meta.validator,
+                           help=meta.help)
     return parser
 
 
@@ -138,7 +252,14 @@ def main(argv=None) -> int:
         return run_propose(args)
     spec = ENDPOINTS[args.command]
     params = {p: getattr(args, p, None) for p in spec.params}
-    result = Responder(args.address).request(spec, params)
+    auth = None
+    if args.token:
+        auth = f"Bearer {args.token}"
+    elif args.username is not None:
+        import base64
+        creds = f"{args.username}:{args.password or ''}".encode()
+        auth = "Basic " + base64.b64encode(creds).decode()
+    result = Responder(args.address, auth_header=auth).request(spec, params)
     print(json.dumps(result, indent=2))
     return 0 if result.get("httpStatus", 200) < 400 else 1
 

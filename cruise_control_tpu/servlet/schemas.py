@@ -66,8 +66,6 @@ def validate(value: Any, schema: Dict, path: str = "$") -> None:
 
 # ------------------------------------------------------- endpoint schemas
 
-_TIMER = {"type": "object"}
-
 STATE_SCHEMA = {
     "type": "object",
     "required": ["MonitorState", "ExecutorState", "AnalyzerState",

@@ -120,9 +120,12 @@ class BasicSecurityProvider:
         except (binascii.Error, UnicodeDecodeError):
             return None
         entry = self.users.get(name)
-        # Compare bytes: compare_digest on str raises for non-ASCII input.
-        if entry is None or not hmac.compare_digest(entry[0].encode(),
-                                                    password.encode()):
+        # Compare bytes (compare_digest on str raises for non-ASCII), and
+        # ALWAYS compare — an early return on unknown usernames would be a
+        # timing oracle for username enumeration.
+        expected = entry[0].encode() if entry else b"\x00invalid"
+        ok = hmac.compare_digest(expected, password.encode())
+        if entry is None or not ok:
             return None
         return Principal(name=name, role=entry[1])
 

@@ -193,3 +193,16 @@ def test_live_responses_match_schemas(secured_app):
     ):
         body = fetch_done(path, method)
         validate(body, ENDPOINT_SCHEMAS[endpoint])
+
+
+def test_cli_auth_against_secured_server(secured_app):
+    """tpucc must be able to authenticate against a secured server."""
+    from cruise_control_tpu.client.cccli import ENDPOINTS, Responder
+    app = secured_app
+    base = f"http://127.0.0.1:{app.port}"
+    spec = ENDPOINTS["state"]
+    unauth = Responder(base).request(spec, {})
+    assert unauth["httpStatus"] == 401
+    token = base64.b64encode(b"user:go").decode()
+    ok = Responder(base, auth_header=f"Basic {token}").request(spec, {})
+    assert ok["httpStatus"] == 200 and "MonitorState" in ok

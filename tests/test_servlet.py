@@ -210,3 +210,22 @@ def test_two_step_verification_flow():
         assert code in (200, 202)
     finally:
         app2.stop()
+
+
+def test_cli_parameter_validation():
+    """CCParameter semantics: malformed values are rejected client-side
+    (argparse usage error), valid ones normalized."""
+    import pytest
+    from cruise_control_tpu.client.cccli import build_parser
+
+    parser = build_parser()
+    ns = parser.parse_args(["rebalance", "--dryrun", "YES",
+                            "--destination_broker_ids", "1, 2,3"])
+    assert ns.dryrun == "true"
+    assert ns.destination_broker_ids == "1,2,3"
+    for bad in (["rebalance", "--dryrun", "maybe"],
+                ["partition_load", "--entries", "-3"],
+                ["remove_broker", "--brokerid", "1,x"],
+                ["admin", "--enable_self_healing_for", "bogus"]):
+        with pytest.raises(SystemExit):
+            parser.parse_args(bad)
