@@ -122,6 +122,10 @@ def _anomaly_def() -> ConfigDef:
     d.define("broker.failure.alert.threshold.ms", ConfigType.LONG, 900_000)
     d.define("broker.failure.self.healing.threshold.ms", ConfigType.LONG, 1_800_000)
     d.define("anomaly.notifier.class", ConfigType.CLASS, "")
+    # Webhook alerting (SlackSelfHealingNotifier analog): set a URL to route
+    # anomaly alerts to a JSON webhook (Slack/Teams/generic receiver).
+    d.define("anomaly.notifier.webhook.url", ConfigType.STRING, "")
+    d.define("anomaly.notifier.webhook.channel", ConfigType.STRING, "")
     d.define("topic.anomaly.target.replication.factor", ConfigType.INT, None)
     return d
 

@@ -3,8 +3,9 @@
 Reference: ``detector/notifier/AnomalyNotifier.java`` SPI,
 ``SelfHealingNotifier.java:57-148`` (broker-failure alert after 15 min,
 auto-fix after 30 min; per-type self-healing enable flags),
-``NoopNotifier``, ``SlackSelfHealingNotifier`` (webhook alerting — here a
-pluggable alert callback, since outbound webhooks are deployment glue).
+``NoopNotifier``, and ``SlackSelfHealingNotifier`` → the
+``WebhookSelfHealingNotifier`` below (JSON webhook POST per alert) plus a
+pluggable alert callback for custom receivers.
 """
 
 from __future__ import annotations
@@ -112,3 +113,45 @@ class SelfHealingNotifier:
             return NotificationAction(AnomalyNotificationResult.CHECK,
                                       delay_ms=fix_time - now)
         return NotificationAction(AnomalyNotificationResult.FIX)
+
+
+class WebhookSelfHealingNotifier(SelfHealingNotifier):
+    """Webhook-alerting notifier (SlackSelfHealingNotifier.java:40-117 —
+    POST a JSON message to a configured webhook URL per alert; Slack, MS
+    Teams and generic receivers all accept this shape).
+
+    Posts happen on the caller's thread with a short timeout and never raise:
+    a broken webhook must not take down anomaly handling.
+    """
+
+    def __init__(self, webhook_url: str, channel: str = "",
+                 sender: str = "cruise-control-tpu", timeout_s: float = 5.0,
+                 post_fn=None, **kwargs):
+        super().__init__(alert_callback=self._post_alert, **kwargs)
+        self.webhook_url = webhook_url
+        self.channel = channel
+        self.sender = sender
+        self.timeout_s = timeout_s
+        self._post = post_fn or self._http_post
+
+    def _http_post(self, payload: dict) -> None:
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(
+            self.webhook_url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
+
+    def _post_alert(self, anomaly: Anomaly, auto_fix_triggered: bool) -> None:
+        payload = {
+            "username": self.sender,
+            "text": (f"{anomaly.anomaly_type.name} detected: {anomaly}. "
+                     f"Self healing {'started' if auto_fix_triggered else 'not started'}."),
+        }
+        if self.channel:
+            payload["channel"] = self.channel
+        try:
+            self._post(payload)
+        except Exception:    # noqa: BLE001 — alerting must never break handling
+            LOG.warning("webhook alert failed", exc_info=True)

@@ -55,8 +55,15 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
     metadata_client = MetadataClient(backend,
                                      ttl_ms=config["metadata.max.age.ms"])
     capacity_file = config.get("capacity.config.file")
-    resolver = (BrokerCapacityConfigFileResolver(capacity_file)
-                if capacity_file else None)
+    resolver_name = str(config.originals.get(
+        "broker.capacity.config.resolver.class", ""))
+    if "Env" in resolver_name:
+        from cruise_control_tpu.monitor.capacity import BrokerEnvCapacityResolver
+        resolver = BrokerEnvCapacityResolver()
+    elif capacity_file:
+        resolver = BrokerCapacityConfigFileResolver(capacity_file)
+    else:
+        resolver = None
     load_monitor = LoadMonitor(
         metadata_client,
         capacity_resolver=resolver,
@@ -97,12 +104,20 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
     task_runner.reporters = reporters
     executor = Executor(FakeClusterBackend(backend),
                         config.executor_config())
-    notifier = SelfHealingNotifier(
+    notifier_kwargs = dict(
         self_healing_enabled=config["self.healing.enabled"],
         broker_failure_alert_threshold_ms=
             config["broker.failure.alert.threshold.ms"],
         broker_failure_self_healing_threshold_ms=
             config["broker.failure.self.healing.threshold.ms"])
+    webhook_url = config.get("anomaly.notifier.webhook.url")
+    if webhook_url:
+        from cruise_control_tpu.detector.notifier import WebhookSelfHealingNotifier
+        notifier = WebhookSelfHealingNotifier(
+            webhook_url, channel=config.get("anomaly.notifier.webhook.channel", ""),
+            **notifier_kwargs)
+    else:
+        notifier = SelfHealingNotifier(**notifier_kwargs)
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,
         constraint=config.balancing_constraint(),

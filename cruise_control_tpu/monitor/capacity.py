@@ -104,3 +104,73 @@ class BrokerCapacityConfigFileResolver:
                 f"no explicit capacity for broker {broker_id} and "
                 "estimation is disallowed")
         return default
+
+
+class BrokerEnvCapacityResolver:
+    """Environment-variable resolver (the reference's
+    ``BrokerCapacityResolver`` provider family: capacity from deployment env
+    rather than a file — e.g. containerized brokers exporting
+    ``BROKER_CPU_CAPACITY``/``BROKER_NW_IN_CAPACITY``/... at startup)."""
+
+    _ENV_KEYS = {"BROKER_CPU_CAPACITY": Resource.CPU,
+                 "BROKER_NW_IN_CAPACITY": Resource.NW_IN,
+                 "BROKER_NW_OUT_CAPACITY": Resource.NW_OUT,
+                 "BROKER_DISK_CAPACITY": Resource.DISK}
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        import os
+        env = dict(os.environ if env is None else env)
+        cap = np.zeros(NUM_RESOURCES)
+        missing = []
+        for key, res in self._ENV_KEYS.items():
+            if key in env:
+                cap[int(res)] = float(env[key])
+            else:
+                missing.append(key)
+        if missing:
+            raise ValueError(f"missing capacity env vars: {missing}")
+        self._info = BrokerCapacityInfo(capacity=cap, disk_capacities=None,
+                                        num_cores=int(env.get("BROKER_NUM_CORES", 1)))
+
+    def capacity_for_broker(self, rack: str, host: str, broker_id: int,
+                            allow_estimation: bool = True) -> BrokerCapacityInfo:
+        return self._info
+
+
+class TopicConfigDiskCapacityResolver:
+    """Per-broker disk capacity learned from the cluster's own reported
+    log-dir sizes plus a headroom factor (the reference's topic-config
+    provider family: capacity derived from the managed system's metadata
+    instead of static config).  Non-disk resources fall back to a base
+    resolver."""
+
+    def __init__(self, base: BrokerCapacityConfigResolver,
+                 observed_disk_by_broker: Dict[int, float],
+                 headroom_factor: float = 1.25):
+        self.base = base
+        self.observed = dict(observed_disk_by_broker)
+        self.headroom = headroom_factor
+
+    def capacity_for_broker(self, rack: str, host: str, broker_id: int,
+                            allow_estimation: bool = True) -> BrokerCapacityInfo:
+        info = self.base.capacity_for_broker(rack, host, broker_id,
+                                             allow_estimation)
+        observed = self.observed.get(broker_id)
+        if observed is None or not allow_estimation:
+            # Observed-usage capacity IS an estimation — honor the caller's
+            # allow_estimation=False by returning only configured values.
+            return info
+        cap = np.array(info.capacity, copy=True)
+        target = max(cap[int(Resource.DISK)], observed * self.headroom)
+        disks = info.disk_capacities
+        if disks is not None and cap[int(Resource.DISK)] > 0:
+            # JBOD: the model derives broker DISK from the per-logdir sum,
+            # so the raise must be applied to the logdirs proportionally.
+            scale = target / cap[int(Resource.DISK)]
+            disks = [d * scale for d in disks]
+        cap[int(Resource.DISK)] = target
+        return BrokerCapacityInfo(capacity=cap,
+                                  disk_capacities=disks,
+                                  num_cores=info.num_cores,
+                                  estimated=True,
+                                  estimation_info="observed disk + headroom")

@@ -190,3 +190,24 @@ def test_manager_priority_and_fix_dispatch():
     assert fixed == [AnomalyType.BROKER_FAILURE, AnomalyType.GOAL_VIOLATION]
     summary = mgr.state_summary()
     assert summary["metrics"]["FIX_STARTED"] == 2
+
+
+def test_webhook_notifier_posts_and_survives_failure():
+    from cruise_control_tpu.detector.notifier import WebhookSelfHealingNotifier
+    from cruise_control_tpu.detector.anomalies import GoalViolations
+
+    posts = []
+    n = WebhookSelfHealingNotifier("http://hook.invalid/x", channel="#alerts",
+                                   post_fn=posts.append,
+                                   self_healing_enabled=False)
+    a = GoalViolations(fixable=["RackAwareGoal"])
+    action = n.on_anomaly(a)
+    assert action.result.name == "IGNORE"   # self-healing disabled -> alert only
+    assert posts and "GOAL_VIOLATION" in posts[0]["text"]
+    assert posts[0]["channel"] == "#alerts"
+
+    def boom(payload):
+        raise OSError("webhook down")
+    n2 = WebhookSelfHealingNotifier("http://hook.invalid/x", post_fn=boom,
+                                    self_healing_enabled=False)
+    n2.on_anomaly(a)    # must not raise

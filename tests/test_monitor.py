@@ -321,3 +321,30 @@ def test_forecast_far_gap_carries_forward():
     for w in (7, 9, 10):
         wi = vae.windows.index(w)
         assert vae.values[md.CPU_USAGE, wi] == pytest.approx(5.0, abs=1e-3), w
+
+
+def test_env_and_topic_config_capacity_resolvers():
+    from cruise_control_tpu.monitor.capacity import (
+        BrokerEnvCapacityResolver,
+        FixedBrokerCapacityResolver,
+        TopicConfigDiskCapacityResolver,
+    )
+    from cruise_control_tpu.common.resources import Resource
+
+    env = {"BROKER_CPU_CAPACITY": "64", "BROKER_NW_IN_CAPACITY": "1e5",
+           "BROKER_NW_OUT_CAPACITY": "1e5", "BROKER_DISK_CAPACITY": "5e5",
+           "BROKER_NUM_CORES": "8"}
+    r = BrokerEnvCapacityResolver(env=env)
+    info = r.capacity_for_broker("r", "h", 3)
+    assert info.capacity[Resource.CPU] == 64.0
+    assert info.num_cores == 8
+    with pytest.raises(ValueError):
+        BrokerEnvCapacityResolver(env={})
+
+    base = FixedBrokerCapacityResolver({Resource.CPU: 100.0,
+                                        Resource.NW_IN: 1e5,
+                                        Resource.NW_OUT: 1e5,
+                                        Resource.DISK: 1e5})
+    t = TopicConfigDiskCapacityResolver(base, {0: 2e5}, headroom_factor=1.5)
+    assert t.capacity_for_broker("r", "h", 0).capacity[Resource.DISK] == 3e5
+    assert t.capacity_for_broker("r", "h", 1).capacity[Resource.DISK] == 1e5
