@@ -255,27 +255,46 @@ def currently_offline(gctx: GoalContext, placement: Placement, r=None):
 
 def apply_replica_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
                        r, dst, dst_disk):
-    """Apply inter-broker move of replica r to (dst, dst_disk); returns new
-    (placement, agg).  All scalar scatter updates — the lax.scan step body."""
+    """Scalar convenience wrapper over ``apply_replica_moves_batch`` (one
+    source of truth for the nine aggregate updates)."""
+    return apply_replica_moves_batch(
+        gctx, placement, agg,
+        jnp.asarray(r)[None], jnp.asarray(dst)[None], jnp.asarray(dst_disk)[None])
+
+
+def apply_replica_moves_batch(gctx: GoalContext, placement: Placement,
+                              agg: Aggregates, r: jnp.ndarray,
+                              dst: jnp.ndarray, dst_disk: jnp.ndarray):
+    """Apply a conflict-free BATCH of inter-broker moves incrementally.
+
+    ``r/dst/dst_disk`` are [C]; rows whose ``dst`` equals the replica's
+    current broker are no-ops (their +/- deltas cancel), which is how phases
+    encode "not kept".  O(C) scatter-adds instead of the O(R) full
+    ``compute_aggregates`` recompute — the per-phase cost at 1M replicas.
+    Returns (placement, agg).
+    """
     state = gctx.state
     src = placement.broker[r]
     src_disk = placement.disk[r]
-    load = replica_role_load(gctx, placement, r)
+    load = replica_role_load(gctx, placement, r)          # [C,4]
     is_lead = placement.is_leader[r]
     topic = state.topic[r]
     pot = state.leader_load[r, Resource.NW_OUT]
     lbi = jnp.where(is_lead, state.leader_load[r, Resource.NW_IN], 0.0)
+    inc = is_lead.astype(jnp.int32)
+    one = jnp.ones_like(r, dtype=jnp.int32)
 
     broker_load = agg.broker_load.at[src].add(-load).at[dst].add(load)
-    host_load = agg.host_load.at[state.host[src]].add(-load).at[state.host[dst]].add(load)
-    replica_counts = agg.replica_counts.at[src].add(-1).at[dst].add(1)
-    inc = is_lead.astype(jnp.int32)
+    host_load = (agg.host_load.at[state.host[src]].add(-load)
+                 .at[state.host[dst]].add(load))
+    replica_counts = agg.replica_counts.at[src].add(-one).at[dst].add(one)
     leader_counts = agg.leader_counts.at[src].add(-inc).at[dst].add(inc)
-    topic_counts = agg.topic_counts.at[topic, src].add(-1).at[topic, dst].add(1)
+    topic_counts = (agg.topic_counts.at[topic, src].add(-one)
+                    .at[topic, dst].add(one))
     topic_leader_counts = (agg.topic_leader_counts.at[topic, src].add(-inc)
                            .at[topic, dst].add(inc))
-    disk_load = (agg.disk_load.at[src, src_disk].add(-load[Resource.DISK])
-                 .at[dst, dst_disk].add(load[Resource.DISK]))
+    disk_load = (agg.disk_load.at[src, src_disk].add(-load[:, Resource.DISK])
+                 .at[dst, dst_disk].add(load[:, Resource.DISK]))
     potential = agg.potential_nw_out.at[src].add(-pot).at[dst].add(pot)
     leader_bytes_in = agg.leader_bytes_in.at[src].add(-lbi).at[dst].add(lbi)
 
@@ -291,6 +310,48 @@ def apply_replica_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
         leader_bytes_in=leader_bytes_in,
     )
     return placement, agg
+
+
+def apply_leadership_moves_batch(gctx: GoalContext, placement: Placement,
+                                 agg: Aggregates, f: jnp.ndarray,
+                                 old: jnp.ndarray, keep: jnp.ndarray,
+                                 demote: Optional[jnp.ndarray] = None):
+    """Apply a conflict-free batch of promotions (f gains, old loses),
+    gated by ``keep`` — non-kept rows contribute zero deltas.  ``demote``
+    separately gates the old-leader side (default: same as ``keep``; the
+    leaderless-partition case promotes without demoting anyone).  The caller
+    has already flipped ``placement.is_leader``; this updates only the
+    aggregates, O(C)."""
+    state = gctx.state
+    demote = keep if demote is None else demote
+    k = keep[:, None]
+    kd = demote[:, None]
+    f_b = placement.broker[f]
+    o_b = placement.broker[old]
+    d_new = jnp.where(k, state.leader_load[f] - state.follower_load[f], 0.0)
+    d_old = jnp.where(kd, state.follower_load[old] - state.leader_load[old], 0.0)
+    inc = keep.astype(jnp.int32)
+    dec = demote.astype(jnp.int32)
+
+    broker_load = agg.broker_load.at[f_b].add(d_new).at[o_b].add(d_old)
+    host_load = (agg.host_load.at[state.host[f_b]].add(d_new)
+                 .at[state.host[o_b]].add(d_old))
+    leader_counts = agg.leader_counts.at[f_b].add(inc).at[o_b].add(-dec)
+    topic_leader_counts = (agg.topic_leader_counts
+                           .at[state.topic[f], f_b].add(inc)
+                           .at[state.topic[old], o_b].add(-dec))
+    disk_load = (agg.disk_load.at[f_b, placement.disk[f]]
+                 .add(d_new[:, Resource.DISK])
+                 .at[o_b, placement.disk[old]].add(d_old[:, Resource.DISK]))
+    lbi_gain = jnp.where(keep, state.leader_load[f, Resource.NW_IN], 0.0)
+    lbi_lose = jnp.where(demote, -state.leader_load[old, Resource.NW_IN], 0.0)
+    leader_bytes_in = (agg.leader_bytes_in.at[f_b].add(lbi_gain)
+                       .at[o_b].add(lbi_lose))
+    return agg.replace(
+        broker_load=broker_load, host_load=host_load,
+        leader_counts=leader_counts, topic_leader_counts=topic_leader_counts,
+        disk_load=disk_load, leader_bytes_in=leader_bytes_in,
+    )
 
 
 def apply_intra_disk_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
@@ -320,44 +381,20 @@ def apply_leadership_move(gctx: GoalContext, placement: Placement, agg: Aggregat
 
     Load semantics per ``ClusterModel.relocateLeadership`` :402-434: the old
     leader keeps only its follower-role load; the new leader takes leader-role
-    load — here realised by flipping the is_leader mask and applying the two
-    role-load deltas.
+    load.  Scalar convenience wrapper over ``apply_leadership_moves_batch``
+    (one source of truth for the aggregate deltas).
     """
     state = gctx.state
-    p = state.partition[f]
-    old = current_leader_of(gctx, placement, p)
+    old = current_leader_of(gctx, placement, state.partition[f])
     old_safe = jnp.maximum(old, 0)
     has_old = old >= 0
-
-    f_b = placement.broker[f]
-    o_b = placement.broker[old_safe]
-    d_new = state.leader_load[f] - state.follower_load[f]       # gained at f's broker
-    d_old = jnp.where(has_old,
-                      state.follower_load[old_safe] - state.leader_load[old_safe],
-                      jnp.zeros_like(d_new))                    # lost at old broker
-
-    broker_load = agg.broker_load.at[f_b].add(d_new).at[o_b].add(d_old)
-    host_load = (agg.host_load.at[state.host[f_b]].add(d_new)
-                 .at[state.host[o_b]].add(d_old))
-    dec = has_old.astype(jnp.int32)
-    leader_counts = agg.leader_counts.at[f_b].add(1).at[o_b].add(-dec)
-    topic = state.topic[f]
-    topic_leader_counts = (agg.topic_leader_counts.at[topic, f_b].add(1)
-                           .at[topic, o_b].add(-dec))
-    disk_load = (agg.disk_load.at[f_b, placement.disk[f]].add(d_new[Resource.DISK])
-                 .at[o_b, placement.disk[old_safe]].add(d_old[Resource.DISK]))
-    leader_bytes_in = (agg.leader_bytes_in.at[f_b].add(state.leader_load[f, Resource.NW_IN])
-                       .at[o_b].add(jnp.where(
-                           has_old, -state.leader_load[old_safe, Resource.NW_IN], 0.0)))
 
     is_leader = placement.is_leader.at[f].set(True)
     is_leader = jnp.where(has_old, is_leader.at[old_safe].set(False), is_leader)
     placement = placement.replace(is_leader=is_leader)
-    agg = agg.replace(
-        broker_load=broker_load, host_load=host_load, leader_counts=leader_counts,
-        topic_leader_counts=topic_leader_counts, disk_load=disk_load,
-        leader_bytes_in=leader_bytes_in,
-    )
+    agg = apply_leadership_moves_batch(
+        gctx, placement, agg, jnp.asarray(f)[None], old_safe[None],
+        keep=jnp.asarray(True)[None], demote=has_old[None])
     return placement, agg
 
 

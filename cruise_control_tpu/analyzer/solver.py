@@ -38,11 +38,14 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer.context import (
     Aggregates,
     GoalContext,
+    apply_leadership_moves_batch,
+    apply_replica_moves_batch,
     base_leadership_ok,
     base_replica_move_ok,
     compute_aggregates,
     current_leader_of,
     currently_offline,
+    replica_role_load,
 )
 from cruise_control_tpu.analyzer.goals.base import Goal
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
@@ -206,14 +209,13 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
             keep = keep & _group_winners(order, placement.broker[cand], b)
 
         dst_disk = _pick_dst_disk(gctx, agg, dst)
-        new_broker = jnp.where(keep, dst, placement.broker[cand])
-        new_disk = jnp.where(keep, dst_disk, placement.disk[cand])
-        placement = placement.replace(
-            broker=placement.broker.at[cand].set(new_broker),
-            disk=placement.disk.at[cand].set(new_disk),
-        )
+        # Incremental aggregate update (O(C) scatters, not an O(R) recompute):
+        # non-kept rows target their own broker/disk, so their deltas cancel.
+        dst_eff = jnp.where(keep, dst, placement.broker[cand])
+        disk_eff = jnp.where(keep, dst_disk, placement.disk[cand])
+        placement, agg = apply_replica_moves_batch(gctx, placement, agg,
+                                                   cand, dst_eff, disk_eff)
         applied = jnp.sum(keep.astype(jnp.int32))
-        agg = compute_aggregates(gctx, placement)
         return placement, agg, applied
 
     return phase
@@ -255,7 +257,8 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
                      .at[jnp.where(keep, old_safe, dummy)].set(False, mode="drop"))
         placement = placement.replace(is_leader=is_leader)
         applied = jnp.sum(keep.astype(jnp.int32))
-        agg = compute_aggregates(gctx, placement)
+        agg = apply_leadership_moves_batch(gctx, placement, agg,
+                                           cand, old_safe, keep)
         return placement, agg, applied
 
     return phase
@@ -368,10 +371,16 @@ def _intra_disk_phase(goal: Goal, num_candidates: int):
                 & _group_winners(order, dst_key, nseg))
 
         new_disk = jnp.where(keep, best, placement.disk[cand])
+        # Incremental: only disk_load changes for intra-broker moves.  Use the
+        # ROLE-based disk size — a follower's follower_load DISK is what the
+        # aggregate holds for it.
+        size = jnp.where(keep, replica_role_load(gctx, placement, cand)[:, 3], 0.0)
+        disk_load = (agg.disk_load
+                     .at[b_of, placement.disk[cand]].add(-size)
+                     .at[b_of, new_disk].add(size))
         placement = placement.replace(disk=placement.disk.at[cand].set(new_disk))
         applied = jnp.sum(keep.astype(jnp.int32))
-        agg = compute_aggregates(gctx, placement)
-        return placement, agg, applied
+        return placement, agg.replace(disk_load=disk_load), applied
 
     return phase
 

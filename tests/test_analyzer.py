@@ -320,3 +320,61 @@ def test_solution_quality_stdev_contract():
         active = np.asarray(before.avg_util) > 1e-9
         assert (cv_a[active] <= cv_b[active] + 1e-6).all(), (name, cv_b, cv_a)
         assert (cv_a <= np.asarray(bounds[name]) + 1e-6).all(), (name, cv_a)
+
+
+def test_batch_appliers_match_recompute():
+    """The incremental batch appliers (the solver's per-phase path) must stay
+    in lockstep with a full compute_aggregates recompute — mixed kept/no-op
+    batches, leadership with demotions, and intra-disk sizes included."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.context import (
+        apply_leadership_moves_batch,
+        apply_replica_moves_batch,
+        build_context,
+        current_leader_of,
+    )
+    from cruise_control_tpu.analyzer.options import OptimizationOptions
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(num_brokers=8, num_racks=4, num_topics=10,
+                                 num_replicas=256, seed=17)
+    state, placement, meta = rc.generate(props, pad_replicas_to=256)
+    gctx = build_context(state, placement, meta, BalancingConstraint(),
+                         OptimizationOptions())
+    agg = compute_aggregates(gctx, placement)
+
+    # Mixed batch: rows 0-3 really move, rows 4-7 are no-ops (dst == src).
+    valid_rows = np.nonzero(np.asarray(state.valid))[0][:8]
+    r = jnp.asarray(valid_rows, dtype=jnp.int32)
+    src = placement.broker[r]
+    dst = jnp.where(jnp.arange(8) < 4, (src + 1) % 8, src)
+    placement2, agg2 = apply_replica_moves_batch(
+        gctx, placement, agg, r, dst, placement.disk[r])
+    fresh = compute_aggregates(gctx, placement2)
+    for got, want in zip(jtu.tree_leaves(agg2), jtu.tree_leaves(fresh)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-3)
+
+    # Leadership batch: promote two followers (their partitions' leaders
+    # demote), with one non-kept row contributing nothing.
+    lead = np.asarray(current_leader_of(gctx, placement2, gctx.state.partition))
+    followers = np.nonzero(~np.asarray(placement2.is_leader)
+                           & np.asarray(state.valid) & (lead >= 0))[0]
+    parts = np.asarray(state.partition)[followers]
+    _, first_idx = np.unique(parts, return_index=True)
+    followers = followers[np.sort(first_idx)][:3]
+    f = jnp.asarray(followers, dtype=jnp.int32)
+    old = jnp.maximum(jnp.asarray(lead[followers], dtype=jnp.int32), 0)
+    keep = jnp.asarray([True, True, False])
+    dummy = gctx.state.num_replicas_padded
+    is_leader = (placement2.is_leader
+                 .at[jnp.where(keep, f, dummy)].set(True, mode="drop")
+                 .at[jnp.where(keep, old, dummy)].set(False, mode="drop"))
+    placement3 = placement2.replace(is_leader=is_leader)
+    agg3 = apply_leadership_moves_batch(gctx, placement3, agg2, f, old, keep)
+    fresh3 = compute_aggregates(gctx, placement3)
+    for got, want in zip(jtu.tree_leaves(agg3), jtu.tree_leaves(fresh3)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-3)
