@@ -50,6 +50,16 @@ class Goal:
     # True when accept_replica_move depends on the SOURCE broker's state —
     # the solver then limits batches to one outbound move per source.
     src_sensitive_accept: bool = False
+    # Multi-accept: True when this goal's band/capacity math is expressible
+    # as CUMULATIVE per-broker slacks (dst/src_cumulative_slack below), so a
+    # destination may absorb several candidates in ONE round as long as their
+    # cumulative consumption fits the headroom.  False forces the solver back
+    # to one-move-per-destination batches whenever this goal is in play.
+    multi_accept_safe: bool = False
+    # True when the goal constrains per-(topic, broker) counts — the solver
+    # then keeps at most one move per (topic, destination) and (topic,
+    # source) pair per round.
+    needs_topic_group: bool = False
 
     def key(self) -> str:
         """Jit-cache key; goals with numeric config should include it here."""
@@ -123,6 +133,21 @@ class Goal:
                                agg: Aggregates, f):
         """actionAcceptance for later goals' leadership promotions."""
         return jnp.broadcast_to(jnp.asarray(True), jnp.shape(f))
+
+    # --------------------------------------------------- multi-accept slack
+
+    def dst_cumulative_slack(self, gctx: GoalContext, placement: Placement,
+                             agg: Aggregates, cand_load, is_lead_cand):
+        """Optional (weight f32[C], slack f32[B]) arrival-side constraint:
+        the cumulative ``weight`` of candidates accepted by a destination in
+        one round must stay within ``slack[dst]``.  None = unconstrained.
+        ``cand_load`` is the candidates' role load f32[C,4]."""
+        return None
+
+    def src_cumulative_slack(self, gctx: GoalContext, placement: Placement,
+                             agg: Aggregates, cand_load, is_lead_cand):
+        """Departure-side analog: cumulative weight leaving one source."""
+        return None
 
     # ----------------------------------------------------------------- swap
     # The reference's third rebalancing mechanism

@@ -27,6 +27,7 @@ class CapacityGoal(Goal):
     """One resource's hard utilization cap (CapacityGoal.java:40-466)."""
 
     is_hard = True
+    multi_accept_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -86,6 +87,18 @@ class CapacityGoal(Goal):
         after = agg.broker_load[dst, res] + load
         return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
 
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        res = self.resource
+        limit = gctx.capacity_threshold[res] * gctx.state.capacity[:, res]
+        return cand_load[:, res], limit - agg.broker_load[:, res]
+
+    def host_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        res = self.resource
+        if not IS_HOST_RESOURCE[res]:
+            return None
+        limit = gctx.capacity_threshold[res] * gctx.host_capacity[:, res]
+        return cand_load[:, res], limit - agg.host_load[:, res]
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Exact: only the load DELTA lands on each end (the directional
         default would double-count and veto swaps near the cap)."""
@@ -144,6 +157,7 @@ class ReplicaCapacityGoal(Goal):
 
     name = "ReplicaCapacityGoal"
     is_hard = True
+    multi_accept_safe = True
 
     def violated_brokers(self, gctx, placement, agg):
         alive = alive_mask(gctx)
@@ -164,6 +178,10 @@ class ReplicaCapacityGoal(Goal):
     def accept_replica_move(self, gctx, placement, agg, r, dst):
         del r
         return agg.replica_counts[dst] + 1 <= gctx.max_replicas_per_broker
+
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        slack = (gctx.max_replicas_per_broker - agg.replica_counts).astype(jnp.float32)
+        return jnp.ones(cand_load.shape[0], dtype=jnp.float32), slack
 
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Swaps are count-neutral."""

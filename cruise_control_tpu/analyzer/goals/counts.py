@@ -39,6 +39,7 @@ class ReplicaDistributionGoal(Goal):
     is_hard = False
     has_pull_phase = True
     src_sensitive_accept = True
+    multi_accept_safe = True
 
     def _counts(self, gctx, agg):
         return agg.replica_counts
@@ -93,6 +94,19 @@ class ReplicaDistributionGoal(Goal):
         del r
         return self._counts(gctx, agg)[dst].astype(jnp.float32)
 
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        upper, _ = self._bounds(gctx, agg)
+        w = self._count_weight(cand_load, is_lead_cand)
+        return w, (upper - self._counts(gctx, agg)).astype(jnp.float32)
+
+    def src_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        _, lower = self._bounds(gctx, agg)
+        w = self._count_weight(cand_load, is_lead_cand)
+        return w, (self._counts(gctx, agg) - lower).astype(jnp.float32)
+
+    def _count_weight(self, cand_load, is_lead_cand):
+        return jnp.ones(cand_load.shape[0], dtype=jnp.float32)
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """A swap is count-neutral on both brokers — always acceptable."""
         return jnp.broadcast_to(jnp.asarray(True), jnp.broadcast_shapes(
@@ -130,6 +144,10 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     name = "LeaderReplicaDistributionGoal"
     uses_leadership_moves = True
     has_pull_phase = False
+
+    def _count_weight(self, cand_load, is_lead_cand):
+        # Only leader candidates move leader counts.
+        return is_lead_cand.astype(jnp.float32)
 
     def _counts(self, gctx, agg):
         return agg.leader_counts
@@ -207,6 +225,8 @@ class TopicReplicaDistributionGoal(Goal):
     name = "TopicReplicaDistributionGoal"
     is_hard = False
     src_sensitive_accept = True
+    multi_accept_safe = True
+    needs_topic_group = True
 
     def _bounds(self, gctx, agg):
         """(upper i32[T], lower i32[T]) per-topic count bands."""

@@ -42,6 +42,7 @@ class ResourceDistributionGoal(Goal):
     has_pull_phase = True
     has_swap_phase = True
     src_sensitive_accept = True
+    multi_accept_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -130,6 +131,16 @@ class ResourceDistributionGoal(Goal):
         load = replica_role_load(gctx, placement, r)[..., res]
         after = agg.broker_load[dst, res] + load
         return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
+
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        upper, _, _ = self._bounds(gctx, agg)
+        return cand_load[:, self.resource], upper - agg.broker_load[:, self.resource]
+
+    def src_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        _, lower, lower_active = self._bounds(gctx, agg)
+        load = agg.broker_load[:, self.resource]
+        slack = jnp.where(lower_active, load - lower, jnp.inf)
+        return cand_load[:, self.resource], slack
 
     # ------------------------------------------------------------ swap phase
     # ResourceDistributionGoal.java:543-725: when no broker has one-way
@@ -315,6 +326,7 @@ class PotentialNwOutGoal(Goal):
 
     name = "PotentialNwOutGoal"
     is_hard = False
+    multi_accept_safe = True
 
     def _limit(self, gctx, b):
         return (gctx.capacity_threshold[Resource.NW_OUT]
@@ -345,6 +357,12 @@ class PotentialNwOutGoal(Goal):
         return (agg.potential_nw_out[dst] + pot) / jnp.maximum(
             gctx.state.capacity[dst, Resource.NW_OUT], 1e-9)
 
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        b = jnp.arange(gctx.state.num_brokers_padded)
+        # Marker weight: the solver substitutes the candidates' potential
+        # (leader-role NW_OUT regardless of current role).
+        return ("potential_nw_out", self._limit(gctx, b) - agg.potential_nw_out)
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Only the potential-NW-out DELTA lands on each end."""
         d = (gctx.state.leader_load[jnp.asarray(r_out), Resource.NW_OUT]
@@ -367,6 +385,7 @@ class LeaderBytesInDistributionGoal(Goal):
     is_hard = False
     uses_replica_moves = False
     uses_leadership_moves = True
+    multi_accept_safe = True
 
     def _limit(self, gctx, agg):
         alive = alive_mask(gctx)
@@ -413,6 +432,12 @@ class LeaderBytesInDistributionGoal(Goal):
         after = agg.leader_bytes_in[dst] + nw_in
         was_over = agg.leader_bytes_in[dst] > limit
         return (after <= limit) | was_over & (nw_in <= 0)
+
+    def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
+        limit = self._limit(gctx, agg)
+        # weight = leader bytes-in carried only by LEADER candidates; the
+        # solver multiplies by is_lead_cand via the special marker below.
+        return ("leader_nw_in", limit - agg.leader_bytes_in)
 
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Only the leader-bytes-in DELTA lands on each end."""

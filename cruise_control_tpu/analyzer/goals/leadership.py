@@ -28,6 +28,7 @@ class PreferredLeaderElectionGoal(Goal):
     not excluded from leadership)."""
 
     name = "PreferredLeaderElectionGoal"
+    multi_accept_safe = True
     is_hard = False
     is_direct = True
     uses_replica_moves = False

@@ -48,6 +48,7 @@ class RackAwareGoal(Goal):
 
     name = "RackAwareGoal"
     is_hard = True
+    multi_accept_safe = True
 
     def violated_brokers(self, gctx, placement, agg):
         viol = replicas_violating_rack(gctx, placement)
@@ -86,6 +87,7 @@ class RackAwareDistributionGoal(Goal):
 
     name = "RackAwareDistributionGoal"
     is_hard = True
+    multi_accept_safe = True
 
     def _rack_cap(self, gctx, r):
         """i32[...]: max allowed replicas of r's partition per rack."""

@@ -11,20 +11,22 @@ round (one jitted call per goal class)
  2. build the C×B feasibility mask: structural legitMove ∧ this goal's
     self-condition ∧ every prior goal's actionAcceptance               (O(C·B))
  3. per-candidate best destination by goal cost ``argmin``             (O(C·B))
- 4. conflict-free selection: keep at most one move per source broker,
-    destination broker, destination host and partition (segment-min over
-    the priority order)                                                (O(C))
- 5. apply ALL kept moves with one masked scatter + full aggregate
-    recompute (segment-sums)                                           (O(R))
+ 4. conflict-free selection: one move per partition always; per
+    destination/host/source, EITHER at most one move (fallback) OR —
+    when every in-play goal declares cumulative slacks — as many moves
+    as the group's headroom fits, checked by within-group cumulative
+    sums in priority order (multi-accept)                     (O(C log C))
+ 5. apply ALL kept moves with O(C) incremental scatter deltas
+    (full aggregate recompute only at round start)                     (O(C))
 
 Why step 4 makes batching safe: every predicate in step 2 was evaluated
-against the round-start state; restricting the batch to one move per
-source/destination/host/partition means no kept move can invalidate another
-kept move's capacity, count-band, balance-band or rack check — so every
-applied move satisfies exactly what the reference's immediate-mutation loop
-would have checked.  Load conservation keeps balance-band thresholds fixed
-within a round.  Anything skipped by conflict resolution is simply picked up
-next round against fresh aggregates.
+against the round-start state; bounding each destination/host/source group's
+CUMULATIVE consumption by the tightest in-play headroom means no subset of
+kept moves can invalidate another kept move's capacity, count-band or
+balance-band check, and partition uniqueness keeps rack/sibling predicates
+exact.  Load conservation keeps balance-band thresholds fixed within a
+round.  Anything skipped by conflict resolution is simply picked up next
+round against fresh aggregates.
 """
 
 from __future__ import annotations
@@ -49,6 +51,7 @@ from cruise_control_tpu.analyzer.context import (
 )
 from cruise_control_tpu.analyzer.goals.base import Goal
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
+from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
 
 _SCORE_FLOOR = -1e29  # candidate scores below this are "not a candidate"
@@ -155,6 +158,65 @@ def _src_sensitive(goal: Goal, priors: Sequence[Goal]) -> bool:
                for g in (goal, *priors))
 
 
+def _cumulative_group_ok(order: jnp.ndarray, group: jnp.ndarray,
+                         active: jnp.ndarray, constraints, c: int) -> jnp.ndarray:
+    """bool[C]: does each active candidate fit its group's CUMULATIVE slacks.
+
+    Candidates are processed in priority ``order`` within each ``group``
+    (destination / source / host); a candidate passes iff, for every
+    (weight[C], slack_of_row[C]) constraint, the running sum of weights of
+    the ACTIVE candidates ahead of it in its group (including itself) stays
+    within the group's slack.  One argsort + K cumsums — O(C log C).
+    """
+    key = group * (c + 1) + jnp.where(active, order, c)
+    perm = jnp.argsort(key)
+    g_s = group[perm]
+    active_s = active[perm]
+    is_start = jnp.concatenate([jnp.ones((1,), bool), g_s[1:] != g_s[:-1]])
+    ok_s = jnp.ones(c, dtype=bool)
+    for weight, slack_row in constraints:
+        w_s = jnp.where(active_s, weight[perm], 0.0)
+        cum = jnp.cumsum(w_s)
+        excl = cum - w_s
+        # Group base = exclusive cumsum at the group's first element;
+        # weights are >= 0 so excl is non-decreasing and cummax broadcasts it.
+        base = jax.lax.cummax(jnp.where(is_start, excl, -jnp.inf))
+        within = cum - base
+        # Zero-weight candidates never consume slack and must not be vetoed
+        # by an already-negative group slack (mirrors the goals' per-candidate
+        # "was over & consumes nothing" acceptance escapes).
+        ok_s = ok_s & ((within <= slack_row[perm] + 1e-6) | (w_s <= 0.0))
+    return jnp.zeros(c, dtype=bool).at[perm].set(ok_s) | ~active
+
+
+def _multi_accept_constraints(goal: Goal, priors: Sequence[Goal], gctx,
+                              placement, agg, cand, cand_load, is_lead_cand,
+                              axis: str):
+    """Gather (weight[C], slack[B]) cumulative constraints for one axis from
+    the goal + priors (plus, for 'dst', the hard broker-capacity slacks the
+    base feasibility always enforces)."""
+    state = gctx.state
+    out = []
+    for g in (goal, *priors):
+        fn = {"dst": g.dst_cumulative_slack,
+              "src": g.src_cumulative_slack,
+              "host": getattr(g, "host_cumulative_slack",
+                              lambda *a: None)}[axis]
+        got = fn(gctx, placement, agg, cand_load, is_lead_cand)
+        if got is None:
+            continue
+        weight, slack = got
+        if isinstance(weight, str):
+            if weight == "potential_nw_out":
+                weight = state.leader_load[cand, Resource.NW_OUT]
+            elif weight == "leader_nw_in":
+                weight = is_lead_cand * state.leader_load[cand, Resource.NW_IN]
+            else:
+                raise ValueError(f"unknown weight marker {weight!r}")
+        out.append((weight, slack))
+    return out
+
+
 def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                    score_fn: Callable, self_ok_fn: Callable,
                    dst_mask_fn: Optional[Callable] = None,
@@ -163,6 +225,10 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
     (gctx, placement, agg) -> (placement, agg, applied)."""
     accept = _chain_accept_replica(priors)
     need_src_cap = _src_sensitive(goal, priors)
+    multi_accept = all(getattr(g, "multi_accept_safe", False)
+                       for g in (goal, *priors))
+    needs_topic_group = any(getattr(g, "needs_topic_group", False)
+                            for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
         state = gctx.state
@@ -195,18 +261,77 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         dst = jnp.where(ok_assign, assign, fallback)
         feasible = jnp.any(ok, axis=1) & is_cand
 
-        # Conflict-free batch: winners per dst broker / dst host / partition
-        # (and per src broker when any acceptance is source-sensitive), in
-        # candidate-priority order.
+        # Conflict-free batch, candidate-priority order.
         order = jnp.where(feasible, jnp.arange(c, dtype=jnp.int32), c)
         part = state.partition[cand]
         host = state.host[dst]
-        keep = (feasible
-                & _group_winners(order, dst, b)
-                & _group_winners(order, host, gctx.num_hosts)
-                & _group_winners(order, part, gctx.num_partitions))
-        if need_src_cap:
-            keep = keep & _group_winners(order, placement.broker[cand], b)
+        src = placement.broker[cand]
+        keep = feasible & _group_winners(order, part, gctx.num_partitions)
+        if multi_accept:
+            # Multi-accept: a destination/host/source may take SEVERAL
+            # candidates in one round as long as their cumulative consumption
+            # fits every in-play goal's headroom (plus the hard capacity
+            # slacks) — the convergence-rate fix over one-move-per-broker.
+            cand_load = replica_role_load(gctx, placement, cand)    # [C,4]
+            is_lead_c = placement.is_leader[cand]
+            if needs_topic_group:
+                topic = state.topic[cand]
+                nseg = gctx.num_topics * b
+                keep = (keep
+                        & _group_winners(order, topic * b + dst, nseg)
+                        & _group_winners(order, topic * b + src, nseg))
+            dst_cons = _multi_accept_constraints(
+                goal, priors, gctx, placement, agg, cand, cand_load,
+                is_lead_c, "dst")
+            if dst_cons:
+                keep = keep & _cumulative_group_ok(
+                    order, dst, keep,
+                    [(w, s[dst]) for w, s in dst_cons], c)
+            else:
+                # No in-play headroom math to pack against — fall back to
+                # one arrival per destination per round (the pre-multi rule).
+                keep = keep & _group_winners(order, dst, b)
+            # Physical per-logdir fill guard (JBOD): every arrival a broker
+            # takes this round gets the SAME pre-round argmin disk, so their
+            # cumulative size must fit that logdir's remaining capacity.
+            d_n = state.num_disks_per_broker
+            if d_n > 1:
+                dd = _pick_dst_disk(gctx, agg, dst)
+                disk_slack = (state.disk_capacity - agg.disk_load)[dst, dd]
+                keep = keep & _cumulative_group_ok(
+                    order, dst * d_n + dd, keep,
+                    [(cand_load[:, Resource.DISK], disk_slack)], c)
+            # Host-level constraints (same-host moves are host-neutral, so
+            # their weight is zeroed).
+            same_host = state.host[src] == host
+            host_cons = [
+                (jnp.where(same_host, 0.0, w), s[host])
+                for w, s in _multi_accept_constraints(
+                    goal, priors, gctx, placement, agg, cand, cand_load,
+                    is_lead_c, "host")
+            ]
+            if host_cons:
+                keep = keep & _cumulative_group_ok(order, host, keep,
+                                                   host_cons, c)
+            # (No host fallback needed: only host-scoped CapacityGoals read
+            # host state in acceptance, and exactly those supply host_cons.)
+            src_cons = _multi_accept_constraints(
+                goal, priors, gctx, placement, agg, cand, cand_load,
+                is_lead_c, "src")
+            if src_cons:
+                # Dead/offline sources are exempt: evacuation must proceed.
+                src_dead = ~state.alive[src] | currently_offline(
+                    gctx, placement, cand)
+                src_rows = [(w, jnp.where(src_dead, jnp.inf, s[src]))
+                            for w, s in src_cons]
+                keep = keep & _cumulative_group_ok(order, src, keep,
+                                                   src_rows, c)
+        else:
+            keep = (keep
+                    & _group_winners(order, dst, b)
+                    & _group_winners(order, host, gctx.num_hosts))
+            if need_src_cap:
+                keep = keep & _group_winners(order, src, b)
 
         dst_disk = _pick_dst_disk(gctx, agg, dst)
         # Incremental aggregate update (O(C) scatters, not an O(R) recompute):
@@ -374,7 +499,7 @@ def _intra_disk_phase(goal: Goal, num_candidates: int):
         # Incremental: only disk_load changes for intra-broker moves.  Use the
         # ROLE-based disk size — a follower's follower_load DISK is what the
         # aggregate holds for it.
-        size = jnp.where(keep, replica_role_load(gctx, placement, cand)[:, 3], 0.0)
+        size = jnp.where(keep, replica_role_load(gctx, placement, cand)[:, Resource.DISK], 0.0)
         disk_load = (agg.disk_load
                      .at[b_of, placement.disk[cand]].add(-size)
                      .at[b_of, new_disk].add(size))
