@@ -264,14 +264,20 @@ def apply_replica_move(gctx: GoalContext, placement: Placement, agg: Aggregates,
 
 def apply_replica_moves_batch(gctx: GoalContext, placement: Placement,
                               agg: Aggregates, r: jnp.ndarray,
-                              dst: jnp.ndarray, dst_disk: jnp.ndarray):
+                              dst: jnp.ndarray, dst_disk: jnp.ndarray,
+                              keep: Optional[jnp.ndarray] = None):
     """Apply a conflict-free BATCH of inter-broker moves incrementally.
 
     ``r/dst/dst_disk`` are [C]; rows whose ``dst`` equals the replica's
     current broker are no-ops (their +/- deltas cancel), which is how phases
     encode "not kept".  O(C) scatter-adds instead of the O(R) full
     ``compute_aggregates`` recompute — the per-phase cost at 1M replicas.
-    Returns (placement, agg).
+
+    ``keep`` (bool[C], optional) is REQUIRED when ``r`` can contain duplicate
+    rows (e.g. the swap phase's shared in-partners): non-kept rows' deltas are
+    zeroed and their placement writes dropped, so a duplicate no-op row can
+    never clobber a kept row's scatter (duplicate-index ``set`` is
+    last-write-wins).  Returns (placement, agg).
     """
     state = gctx.state
     src = placement.broker[r]
@@ -283,6 +289,12 @@ def apply_replica_moves_batch(gctx: GoalContext, placement: Placement,
     lbi = jnp.where(is_lead, state.leader_load[r, Resource.NW_IN], 0.0)
     inc = is_lead.astype(jnp.int32)
     one = jnp.ones_like(r, dtype=jnp.int32)
+    if keep is not None:
+        load = load * keep[:, None]
+        pot = pot * keep
+        lbi = lbi * keep
+        inc = inc * keep
+        one = one * keep
 
     broker_load = agg.broker_load.at[src].add(-load).at[dst].add(load)
     host_load = (agg.host_load.at[state.host[src]].add(-load)
@@ -298,9 +310,13 @@ def apply_replica_moves_batch(gctx: GoalContext, placement: Placement,
     potential = agg.potential_nw_out.at[src].add(-pot).at[dst].add(pot)
     leader_bytes_in = agg.leader_bytes_in.at[src].add(-lbi).at[dst].add(lbi)
 
+    if keep is None:
+        r_set = r
+    else:
+        r_set = jnp.where(keep, r, state.num_replicas_padded)
     placement = placement.replace(
-        broker=placement.broker.at[r].set(dst),
-        disk=placement.disk.at[r].set(dst_disk),
+        broker=placement.broker.at[r_set].set(dst, mode="drop"),
+        disk=placement.disk.at[r_set].set(dst_disk, mode="drop"),
     )
     agg = Aggregates(
         broker_load=broker_load, host_load=host_load,

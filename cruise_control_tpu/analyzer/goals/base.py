@@ -60,6 +60,15 @@ class Goal:
     # then keeps at most one move per (topic, destination) and (topic,
     # source) pair per round.
     needs_topic_group: bool = False
+    # Multi-swap: True when this goal's swap acceptance composes over several
+    # swaps per broker in one round — either the goal is swap-neutral
+    # (counts/racks unchanged by an exchange) or it bounds the transferred
+    # quantity via ``swap_cumulative_slack`` below.  False forces the swap
+    # phase back to one-swap-per-broker whenever this goal is in play.
+    multi_swap_safe: bool = False
+    # True when multi-swap safety additionally needs at most ONE swap per
+    # (topic, broker) touch per round (per-topic count/leader constraints).
+    swap_topic_group: bool = False
 
     def key(self) -> str:
         """Jit-cache key; goals with numeric config should include it here."""
@@ -188,6 +197,24 @@ class Goal:
         headroom is not credited)."""
         return (self.accept_replica_move(gctx, placement, agg, r_out, b_in)
                 & self.accept_replica_move(gctx, placement, agg, r_in, b_out))
+
+    def swap_cumulative_slack(self, gctx: GoalContext, placement: Placement,
+                              agg: Aggregates, d_load, d_pot, d_lbi, d_lead):
+        """Optional (delta f32[C], upper_slack f32[B], lower_slack f32[B]|None):
+        cumulative bound on the quantity each selected swap pair transfers
+        b_out → b_in.  The solver enforces per round, per receiving broker:
+        summed positive deltas fit ``upper_slack`` and summed negative deltas
+        fit ``lower_slack``; mirrored on the shedding side.  ``d_load`` is the
+        pairs' role-load delta f32[C,4]; ``d_pot``/``d_lbi``/``d_lead`` the potential-NW-out /
+        leader-bytes-in / leader-count deltas f32[C].  None = swap-neutral."""
+        return None
+
+    def swap_host_cumulative_slack(self, gctx: GoalContext, placement: Placement,
+                                   agg: Aggregates, d_load):
+        """(delta f32[C], upper_slack f32[H]) host-scoped analog (upper bound
+        only; same-host swaps are zero-weighted by the solver).  None = no
+        host-level constraint."""
+        return None
 
     # ------------------------------------------------------ pull (move-in)
 

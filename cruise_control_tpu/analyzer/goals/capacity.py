@@ -28,6 +28,7 @@ class CapacityGoal(Goal):
 
     is_hard = True
     multi_accept_safe = True
+    multi_swap_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -99,6 +100,18 @@ class CapacityGoal(Goal):
         limit = gctx.capacity_threshold[res] * gctx.host_capacity[:, res]
         return cand_load[:, res], limit - agg.host_load[:, res]
 
+    def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot, d_lbi, d_lead):
+        res = self.resource
+        limit = gctx.capacity_threshold[res] * gctx.state.capacity[:, res]
+        return d_load[:, res], limit - agg.broker_load[:, res], None
+
+    def swap_host_cumulative_slack(self, gctx, placement, agg, d_load):
+        res = self.resource
+        if not IS_HOST_RESOURCE[res]:
+            return None
+        limit = gctx.capacity_threshold[res] * gctx.host_capacity[:, res]
+        return d_load[:, res], limit - agg.host_load[:, res]
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Exact: only the load DELTA lands on each end (the directional
         default would double-count and veto swaps near the cap)."""
@@ -158,6 +171,7 @@ class ReplicaCapacityGoal(Goal):
     name = "ReplicaCapacityGoal"
     is_hard = True
     multi_accept_safe = True
+    multi_swap_safe = True     # swaps are replica-count-neutral
 
     def violated_brokers(self, gctx, placement, agg):
         alive = alive_mask(gctx)
@@ -209,6 +223,9 @@ class IntraBrokerDiskCapacityGoal(Goal):
     is_hard = True
     uses_replica_moves = False
     intra_disk = True
+    # Inter-broker swaps land on each side's emptiest logdir; the solver's
+    # JBOD cumulative fill guard bounds multi-swap arrivals per logdir.
+    multi_swap_safe = True
 
     def violated_disks(self, gctx, placement, agg):
         limit = gctx.capacity_threshold[Resource.DISK] * gctx.state.disk_capacity

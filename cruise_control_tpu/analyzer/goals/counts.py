@@ -40,6 +40,7 @@ class ReplicaDistributionGoal(Goal):
     has_pull_phase = True
     src_sensitive_accept = True
     multi_accept_safe = True
+    multi_swap_safe = True     # swaps are replica-count-neutral
 
     def _counts(self, gctx, agg):
         return agg.replica_counts
@@ -145,6 +146,13 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     uses_leadership_moves = True
     has_pull_phase = False
 
+    def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot,
+                              d_lbi, d_lead):
+        """Leader counts shift by is_leader(r_out) - is_leader(r_in)."""
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg).astype(jnp.float32)
+        return d_lead, upper - c, c - lower
+
     def _count_weight(self, cand_load, is_lead_cand):
         # Only leader candidates move leader counts.
         return is_lead_cand.astype(jnp.float32)
@@ -227,6 +235,10 @@ class TopicReplicaDistributionGoal(Goal):
     src_sensitive_accept = True
     multi_accept_safe = True
     needs_topic_group = True
+    # One swap per (topic, broker) touch per round keeps every per-topic
+    # count delta within the +/-1 each pairwise accept_swap already checked.
+    multi_swap_safe = True
+    swap_topic_group = True
 
     def _bounds(self, gctx, agg):
         """(upper i32[T], lower i32[T]) per-topic count bands."""

@@ -21,6 +21,9 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
     is_hard = False
     uses_replica_moves = False
     intra_disk = True
+    # Inter-broker swaps land on each side's emptiest logdir; the solver's
+    # JBOD fill guard bounds multi-swap arrivals per logdir.
+    multi_swap_safe = True
 
     def _bands(self, gctx, agg):
         """(upper f32[B,D], lower f32[B,D]) absolute per-disk load bounds."""

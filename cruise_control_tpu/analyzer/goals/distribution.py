@@ -43,6 +43,7 @@ class ResourceDistributionGoal(Goal):
     has_swap_phase = True
     src_sensitive_accept = True
     multi_accept_safe = True
+    multi_swap_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -155,24 +156,34 @@ class ResourceDistributionGoal(Goal):
                 & ~currently_offline(gctx, placement))
 
     def swap_out_score(self, gctx, placement, agg):
-        """Heavy replicas on above-average brokers, heaviest first."""
+        """Heavy replicas first, with a strong bonus for replicas sitting on
+        OVER-band brokers — the swap tiles must contain the violated brokers'
+        replicas or the phase cannot fix them (capacity-fraction units)."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
-        hot = (agg.broker_load[:, res]
-               > avg * gctx.state.capacity[:, res]) & alive_mask(gctx)
+        cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
+        hot = (agg.broker_load[:, res] > avg * cap) & alive_mask(gctx)
+        upper, _, _ = self._bounds(gctx, agg)
+        over_gap = jnp.maximum(agg.broker_load[:, res] - upper, 0.0) / cap
         prio = self.replica_priority(gctx, placement, agg)
-        cand = hot[placement.broker] & self._swap_base_mask(gctx, placement)
-        return jnp.where(cand, prio, NEG_INF)
+        b = placement.broker
+        cand = hot[b] & self._swap_base_mask(gctx, placement)
+        return jnp.where(cand, 8.0 * over_gap[b] + prio / cap[b], NEG_INF)
 
     def swap_in_score(self, gctx, placement, agg):
-        """Light replicas on below-average brokers, lightest first."""
+        """Light replicas first, with a strong bonus for replicas on
+        UNDER-band brokers (their broker must receive swapped-in load)."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
-        cold = (agg.broker_load[:, res]
-                < avg * gctx.state.capacity[:, res]) & alive_mask(gctx)
+        cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
+        cold = (agg.broker_load[:, res] < avg * cap) & alive_mask(gctx)
+        _, lower, lower_active = self._bounds(gctx, agg)
+        under_gap = jnp.where(
+            lower_active, jnp.maximum(lower - agg.broker_load[:, res], 0.0), 0.0) / cap
         prio = self.replica_priority(gctx, placement, agg)
-        cand = cold[placement.broker] & self._swap_base_mask(gctx, placement)
-        return jnp.where(cand, -prio, NEG_INF)
+        b = placement.broker
+        cand = cold[b] & self._swap_base_mask(gctx, placement)
+        return jnp.where(cand, 8.0 * under_gap[b] - prio / cap[b], NEG_INF)
 
     def _swap_after(self, gctx, placement, agg, r_out, r_in):
         """(delta, b_out, b_in, load-after both sides) for the pair tile."""
@@ -209,6 +220,14 @@ class ResourceDistributionGoal(Goal):
         cap_in = jnp.maximum(gctx.state.capacity[b_in, res], 1e-9)
         return (jnp.abs(out_after / cap_out - avg)
                 + jnp.abs(in_after / cap_in - avg))
+
+    def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot, d_lbi, d_lead):
+        res = self.resource
+        upper, lower, lower_active = self._bounds(gctx, agg)
+        load = agg.broker_load[:, res]
+        low_slack = jnp.where(lower_active, load - lower,
+                              jnp.full_like(load, jnp.inf))
+        return d_load[:, res], upper - load, low_slack
 
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Exact pairwise band check: neither end may leave the band in the
@@ -327,6 +346,7 @@ class PotentialNwOutGoal(Goal):
     name = "PotentialNwOutGoal"
     is_hard = False
     multi_accept_safe = True
+    multi_swap_safe = True
 
     def _limit(self, gctx, b):
         return (gctx.capacity_threshold[Resource.NW_OUT]
@@ -363,6 +383,10 @@ class PotentialNwOutGoal(Goal):
         # (leader-role NW_OUT regardless of current role).
         return ("potential_nw_out", self._limit(gctx, b) - agg.potential_nw_out)
 
+    def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot, d_lbi, d_lead):
+        b = jnp.arange(gctx.state.num_brokers_padded)
+        return d_pot, self._limit(gctx, b) - agg.potential_nw_out, None
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Only the potential-NW-out DELTA lands on each end."""
         d = (gctx.state.leader_load[jnp.asarray(r_out), Resource.NW_OUT]
@@ -386,6 +410,7 @@ class LeaderBytesInDistributionGoal(Goal):
     uses_replica_moves = False
     uses_leadership_moves = True
     multi_accept_safe = True
+    multi_swap_safe = True
 
     def _limit(self, gctx, agg):
         alive = alive_mask(gctx)
@@ -438,6 +463,9 @@ class LeaderBytesInDistributionGoal(Goal):
         # weight = leader bytes-in carried only by LEADER candidates; the
         # solver multiplies by is_lead_cand via the special marker below.
         return ("leader_nw_in", limit - agg.leader_bytes_in)
+
+    def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot, d_lbi, d_lead):
+        return d_lbi, self._limit(gctx, agg) - agg.leader_bytes_in, None
 
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Only the leader-bytes-in DELTA lands on each end."""

@@ -29,6 +29,7 @@ class PreferredLeaderElectionGoal(Goal):
 
     name = "PreferredLeaderElectionGoal"
     multi_accept_safe = True
+    multi_swap_safe = True     # swaps keep per-replica roles; PLE unaffected
     is_hard = False
     is_direct = True
     uses_replica_moves = False
@@ -93,6 +94,10 @@ class MinTopicLeadersPerBrokerGoal(Goal):
     name = "MinTopicLeadersPerBrokerGoal"
     is_hard = True
     src_sensitive_accept = True
+    # One swap per (topic, broker) touch per round keeps each per-topic
+    # leader-count delta within the -1 each pairwise acceptance checked.
+    multi_swap_safe = True
+    swap_topic_group = True
     uses_replica_moves = False
     uses_leadership_moves = True
 

@@ -49,6 +49,7 @@ class RackAwareGoal(Goal):
     name = "RackAwareGoal"
     is_hard = True
     multi_accept_safe = True
+    multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
 
     def violated_brokers(self, gctx, placement, agg):
         viol = replicas_violating_rack(gctx, placement)
@@ -88,6 +89,7 @@ class RackAwareDistributionGoal(Goal):
     name = "RackAwareDistributionGoal"
     is_hard = True
     multi_accept_safe = True
+    multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
 
     def _rack_cap(self, gctx, r):
         """i32[...]: max allowed replicas of r's partition per rack."""

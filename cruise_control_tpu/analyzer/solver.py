@@ -58,6 +58,22 @@ _SCORE_FLOOR = -1e29  # candidate scores below this are "not a candidate"
 _INF_COST = jnp.float32(3.4e38)
 
 
+def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False):
+    """(values, indices) of the ~k best-scoring rows, descending.
+
+    ``lax.approx_max_k`` lowers to the TPU PartialReduce op — much faster
+    than the full sort ``lax.top_k`` implies for large k over the replica
+    axis.  Approximate selection is safe for SOFT goals: candidates are
+    re-scored every round, so a recall miss is picked up a round later.
+    HARD goals pass ``exact=True`` — approx misses are deterministic, so a
+    shadowed-but-fixable candidate could repeat a zero-move round and turn
+    the progress-based loop exit into a spurious OptimizationFailureError.
+    """
+    if exact or k >= score.shape[-1]:
+        return jax.lax.top_k(score, k)
+    return jax.lax.approx_max_k(score, k, recall_target=0.95)
+
+
 @dataclass
 class GoalOptimizationInfo:
     """Host-side result of optimizing one goal."""
@@ -235,7 +251,7 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         b = state.num_brokers_padded
         c = num_candidates
         score = score_fn(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, c)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
@@ -297,7 +313,9 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
             d_n = state.num_disks_per_broker
             if d_n > 1:
                 dd = _pick_dst_disk(gctx, agg, dst)
-                disk_slack = (state.disk_capacity - agg.disk_load)[dst, dd]
+                disk_limit = (gctx.capacity_threshold[Resource.DISK]
+                              * state.disk_capacity)
+                disk_slack = (disk_limit - agg.disk_load)[dst, dd]
                 keep = keep & _cumulative_group_ok(
                     order, dst * d_n + dd, keep,
                     [(cand_load[:, Resource.DISK], disk_slack)], c)
@@ -353,7 +371,7 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
         state = gctx.state
         c = num_candidates
         score = goal.leadership_candidate_score(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, c)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
         is_cand = top_score > _SCORE_FLOOR
 
         ok = (is_cand & accept(gctx, placement, agg, cand)
@@ -389,25 +407,36 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     return phase
 
 
-def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
+def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
+                jitter_frac: float = 1.0):
     """Batched replica SWAP round (ResourceDistributionGoal.java:543-725).
 
     top-k heavy replicas on loaded brokers × top-k light replicas on
     less-loaded brokers → C×C pair feasibility (both directions structurally
     legit ∧ every prior goal accepts the swap ∧ this goal's band math says the
     exchange helps) → per-out-candidate best partner by residual imbalance →
-    conflict-free selection where each broker, host and partition is touched
-    by at most ONE kept swap (counting both roles), so the pre-swap
-    feasibility matrix stays valid for every kept pair.
+    conflict-free selection.  Each partition/in-partner is used once; brokers
+    and hosts take EITHER at most one kept swap (fallback) OR — when every
+    in-play goal declares multi-swap composition — as many swaps as their
+    cumulative transferred deltas fit (the convergence-rate fix for brokers
+    whose only legal mechanism is exchanging load, e.g. count-banded
+    NW-full brokers starving for CPU).
     """
     accept = _chain_accept_swap(priors)
+    multi_swap = all(getattr(g, "multi_swap_safe", False)
+                     for g in (goal, *priors))
+    topic_group = any(getattr(g, "needs_topic_group", False)
+                      or getattr(g, "swap_topic_group", False)
+                      for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
         state = gctx.state
         c = num_candidates
         b = state.num_brokers_padded
-        out_top, out_c = jax.lax.top_k(goal.swap_out_score(gctx, placement, agg), c)
-        in_top, in_c = jax.lax.top_k(goal.swap_in_score(gctx, placement, agg), c)
+        out_top, out_c = _top_candidates(goal.swap_out_score(gctx, placement, agg),
+                                         c, exact=goal.is_hard)
+        in_top, in_c = _top_candidates(goal.swap_in_score(gctx, placement, agg),
+                                       c, exact=goal.is_hard)
 
         ro = out_c[:, None]                      # [C,1]
         ri = in_c[None, :]                       # [1,C]
@@ -418,7 +447,12 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
               & (state.partition[ro] != state.partition[ri])
               & goal.swap_ok(gctx, placement, agg, ro, ri)
               & accept(gctx, placement, agg, ro, ri, bo, bi))
-        cost = jnp.where(ok, goal.swap_cost(gctx, placement, agg, ro, ri), _INF_COST)
+        cost_raw = goal.swap_cost(gctx, placement, agg, ro, ri)
+        # Partner jitter spreads rows over distinct in-partners (otherwise
+        # many rows argmin onto the same partner and uniqueness drops them).
+        pos = jnp.arange(c, dtype=jnp.int32)[None, :]
+        cost = jnp.where(ok, _jittered(cost_raw, ok, out_c, pos,
+                                       frac=jitter_frac), _INF_COST)
         sel = jnp.argmin(cost, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(ok, sel[:, None], axis=1)[:, 0]
 
@@ -427,9 +461,8 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
         b_in_sel = placement.broker[r_in_sel]
         order = jnp.where(feasible, jnp.arange(c, dtype=jnp.int32), c)
 
-        # A kept swap touches 2 brokers, 2 hosts, 2 partitions; each entity may
-        # appear in at most one kept swap IN EITHER ROLE, so uniqueness runs
-        # over the concatenation of both roles' keys.
+        # A kept swap touches 2 brokers, 2 hosts, 2 partitions; for the
+        # at-most-once rules, uniqueness runs over both roles' keys.
         def both_roles_winner(key_out, key_in, num_groups):
             keys = jnp.concatenate([key_out, key_in])
             order2 = jnp.concatenate([order, order])
@@ -437,30 +470,113 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
             return (best[key_out] == order) & (best[key_in] == order)
 
         keep = (feasible
-                & both_roles_winner(b_out_row, b_in_sel, b)
-                & both_roles_winner(state.host[b_out_row], state.host[b_in_sel],
-                                    gctx.num_hosts)
                 & both_roles_winner(state.partition[out_c],
                                     state.partition[r_in_sel],
-                                    gctx.num_partitions))
+                                    gctx.num_partitions)
+                # Every in-partner is used by at most one row.
+                & _group_winners(order, r_in_sel, state.num_replicas_padded))
 
         disk_for_out = _pick_dst_disk(gctx, agg, b_in_sel)   # r_out lands on b_in
         disk_for_in = _pick_dst_disk(gctx, agg, b_out_row)   # r_in lands on b_out
-        # Non-kept rows scatter to an out-of-range dummy index (mode='drop'):
-        # r_in_sel may repeat across rows, and a non-kept duplicate writing its
-        # "no-op" value would clobber the kept row's update (last-write-wins).
-        dummy = gctx.state.num_replicas_padded
-        out_idx = jnp.where(keep, out_c, dummy)
-        in_idx = jnp.where(keep, r_in_sel, dummy)
-        new_broker = (placement.broker
-                      .at[out_idx].set(b_in_sel, mode="drop")
-                      .at[in_idx].set(b_out_row, mode="drop"))
-        new_disk = (placement.disk
-                    .at[out_idx].set(disk_for_out, mode="drop")
-                    .at[in_idx].set(disk_for_in, mode="drop"))
-        placement = placement.replace(broker=new_broker, disk=new_disk)
+
+        if multi_swap:
+            if topic_group:
+                # One swap per (topic, broker) TOUCH per round: each row
+                # touches (t_out, b_out/b_in) and (t_in, b_out/b_in).
+                t_out = state.topic[out_c]
+                t_in = state.topic[r_in_sel]
+                nseg = gctx.num_topics * b
+                keep = (keep
+                        & both_roles_winner(t_out * b + b_out_row,
+                                            t_out * b + b_in_sel, nseg)
+                        & both_roles_winner(t_in * b + b_out_row,
+                                            t_in * b + b_in_sel, nseg))
+            # Cumulative per-broker bounds on the transferred deltas.
+            d_load = (replica_role_load(gctx, placement, out_c)
+                      - replica_role_load(gctx, placement, r_in_sel))  # [C,4]
+            lnwout = state.leader_load[:, Resource.NW_OUT]
+            d_pot = lnwout[out_c] - lnwout[r_in_sel]
+            lnwin = state.leader_load[:, Resource.NW_IN]
+            d_lbi = (placement.is_leader[out_c] * lnwin[out_c]
+                     - placement.is_leader[r_in_sel] * lnwin[r_in_sel])
+            d_lead = (placement.is_leader[out_c].astype(jnp.float32)
+                      - placement.is_leader[r_in_sel].astype(jnp.float32))
+            in_rows, out_rows = [], []
+            for g in (goal, *priors):
+                got = g.swap_cumulative_slack(gctx, placement, agg,
+                                              d_load, d_pot, d_lbi, d_lead)
+                if got is None:
+                    continue
+                delta, up, low = got
+                p_w = jnp.maximum(delta, 0.0)
+                n_w = jnp.maximum(-delta, 0.0)
+                in_rows.append((p_w, up[b_in_sel]))
+                out_rows.append((n_w, up[b_out_row]))
+                if low is not None:
+                    in_rows.append((n_w, low[b_in_sel]))
+                    out_rows.append((p_w, low[b_out_row]))
+            if in_rows:
+                keep = keep & _cumulative_group_ok(order, b_in_sel, keep,
+                                                   in_rows, c)
+            if out_rows:
+                keep = keep & _cumulative_group_ok(order, b_out_row, keep,
+                                                   out_rows, c)
+            # Host-scoped bounds (upper only; same-host swaps are neutral).
+            # Both role streams share ONE check per host — a host holding a
+            # hot AND a cold broker must not absorb its slack once per role.
+            h_in = state.host[b_in_sel]
+            h_out = state.host[b_out_row]
+            same_h = h_in == h_out
+            h_rows = []
+            h_group2 = jnp.concatenate([h_in, h_out])
+            for g in (goal, *priors):
+                got = g.swap_host_cumulative_slack(gctx, placement, agg, d_load)
+                if got is None:
+                    continue
+                delta, up_h = got
+                p_w = jnp.where(same_h, 0.0, jnp.maximum(delta, 0.0))
+                n_w = jnp.where(same_h, 0.0, jnp.maximum(-delta, 0.0))
+                h_rows.append((jnp.concatenate([p_w, n_w]), up_h[h_group2]))
+            if h_rows:
+                h_order2 = jnp.concatenate([order * 2, order * 2 + 1])
+                h_act2 = jnp.concatenate([keep, keep])
+                ok_h = _cumulative_group_ok(h_order2, h_group2, h_act2,
+                                            h_rows, 2 * c)
+                keep = keep & ok_h[:c] & ok_h[c:]
+            # JBOD fill guard: both arrival streams (r_out→b_in's logdir,
+            # r_in→b_out's logdir) must cumulatively fit their target disks.
+            d_n = state.num_disks_per_broker
+            if d_n > 1:
+                size_out = replica_role_load(gctx, placement, out_c)[:, Resource.DISK]
+                size_in = replica_role_load(gctx, placement, r_in_sel)[:, Resource.DISK]
+                key_in_arr = b_in_sel * d_n + disk_for_out
+                key_out_arr = b_out_row * d_n + disk_for_in
+                disk_limit = (gctx.capacity_threshold[Resource.DISK]
+                              * state.disk_capacity)
+                disk_slack = (disk_limit - agg.disk_load).reshape(-1)
+                order2 = jnp.concatenate([order * 2, order * 2 + 1])
+                group2 = jnp.concatenate([key_in_arr, key_out_arr])
+                act2 = jnp.concatenate([keep, keep])
+                w2 = jnp.concatenate([size_out, size_in])
+                ok2 = _cumulative_group_ok(
+                    order2, group2, act2, [(w2, disk_slack[group2])], 2 * c)
+                keep = keep & ok2[:c] & ok2[c:]
+        else:
+            keep = (keep
+                    & both_roles_winner(b_out_row, b_in_sel, b)
+                    & both_roles_winner(state.host[b_out_row],
+                                        state.host[b_in_sel], gctx.num_hosts))
+
+        # Incremental apply: a swap is two conflict-free moves.  r_out rows
+        # are distinct (top-k indices) so no-ops encode as dst==src; r_in
+        # rows may repeat across non-kept rows, so they are keep-masked.
+        dst_out = jnp.where(keep, b_in_sel, b_out_row)
+        ddisk_out = jnp.where(keep, disk_for_out, placement.disk[out_c])
+        placement, agg = apply_replica_moves_batch(
+            gctx, placement, agg, out_c, dst_out, ddisk_out)
+        placement, agg = apply_replica_moves_batch(
+            gctx, placement, agg, r_in_sel, b_out_row, disk_for_in, keep=keep)
         applied = jnp.sum(keep.astype(jnp.int32))
-        agg = compute_aggregates(gctx, placement)
         return placement, agg, applied
 
     return phase
@@ -472,7 +588,7 @@ def _intra_disk_phase(goal: Goal, num_candidates: int):
         d_n = state.num_disks_per_broker
         c = num_candidates
         score = goal.disk_candidate_score(gctx, placement, agg)
-        top_score, cand = jax.lax.top_k(score, c)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
@@ -516,12 +632,17 @@ class GoalSolver:
 
     def __init__(self, max_candidates_per_round: int = 4096,
                  max_rounds_per_goal: int = 96,
-                 max_swap_candidates: int = 256,
+                 max_swap_candidates: int = 512,
                  mesh=None,
-                 dst_jitter_frac: float = 1.0):
+                 dst_jitter_frac: float = 1.0,
+                 stall_limit: int = 8):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self.max_swap_candidates = max_swap_candidates
+        # Soft-goal churn cutoff: stop a goal's while_loop after this many
+        # consecutive rounds with neither a violation-count drop nor a
+        # relative stats-metric improvement (>1e-4).
+        self.stall_limit = stall_limit
         # Destination-jitter span as a fraction of each candidate's feasible
         # cost range.  1.0 maximizes batch width (fast convergence); 0.0 is
         # pure greedy argmin (narrow batches).  The measured trade-off is
@@ -564,9 +685,11 @@ class GoalSolver:
                                          dst_mask_fn=goal.pull_dst_mask,
                                          jitter_frac=self.dst_jitter_frac))
         if goal.has_swap_phase:
-            # Swap pairs are C×C; keep the tile small — swaps are the
-            # last-resort mechanism, a few per round suffice.
-            phases.append(_swap_phase(goal, priors, min(self.max_swap_candidates, c)))
+            # Swap pairs are C×C; the tile stays modest (multi-swap keeps
+            # whole sub-batches of it per round).
+            phases.append(_swap_phase(goal, priors,
+                                      min(self.max_swap_candidates, c),
+                                      jitter_frac=self.dst_jitter_frac))
         if getattr(goal, "intra_disk", False):
             phases.append(_intra_disk_phase(goal, c))
         return phases
@@ -621,6 +744,12 @@ class GoalSolver:
     def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         round_body = self._round_body(goal, priors, c)
         max_rounds = jnp.int32(self.max_rounds)
+        stall_limit = jnp.int32(self.stall_limit)
+        # Soft goals only: a hard goal must exhaust its round budget before
+        # the hard-goal check declares failure, but a soft goal that keeps
+        # applying moves without lowering its violation count or improving
+        # its stats metric is just churning — cut the tail.
+        use_stall_cutoff = not goal.is_hard
 
         def solve(gctx: GoalContext, placement: Placement):
             agg0 = compute_aggregates(gctx, placement)
@@ -631,20 +760,33 @@ class GoalSolver:
             metric0 = goal.stats_metric(gctx, placement, agg0)
 
             def cond(carry):
-                _, rounds, applied_last, _, violated, stranded, _ = carry
+                (_, rounds, applied_last, _, violated, stranded, _,
+                 _, _, stall) = carry
                 work = (violated > 0) | (stranded > 0)
                 progress = (rounds == 0) | (applied_last > 0)
-                return work & progress & (rounds < max_rounds)
+                ok = work & progress & (rounds < max_rounds)
+                if use_stall_cutoff:
+                    ok = ok & (stall < stall_limit)
+                return ok
 
             def body(carry):
-                pl, rounds, _, moves, _, _, _ = carry
+                pl, rounds, _, moves, _, _, _, best_work, best_metric, stall = carry
                 pl, applied, violated, stranded, metric = round_body(gctx, pl)
+                work_now = violated + stranded
+                improved = ((work_now < best_work)
+                            | (metric < best_metric
+                               - 1e-4 * jnp.abs(best_metric) - 1e-12))
+                stall = jnp.where(improved, jnp.int32(0), stall + 1)
+                best_work = jnp.minimum(best_work, work_now)
+                best_metric = jnp.minimum(best_metric, metric)
                 return (pl, rounds + 1, applied, moves + applied,
-                        violated, stranded, metric)
+                        violated, stranded, metric, best_work, best_metric,
+                        stall)
 
             init = (placement, jnp.int32(0), jnp.int32(1), jnp.int32(0),
-                    violated0, stranded0, metric0)
-            pl, rounds, _, moves, violated, stranded, metric = \
+                    violated0, stranded0, metric0,
+                    violated0 + stranded0, metric0, jnp.int32(0))
+            pl, rounds, _, moves, violated, stranded, metric, *_ = \
                 jax.lax.while_loop(cond, body, init)
             return (pl, rounds, moves, violated, stranded, metric,
                     violated0, metric0)

@@ -68,6 +68,9 @@ def _analyzer_def() -> ConfigDef:
     d.define("topic.replica.count.balance.threshold", ConfigType.DOUBLE, 3.0)
     d.define("topic.names.with.min.leaders.per.broker", ConfigType.LIST, "")
     d.define("min.topic.leaders.per.broker", ConfigType.INT, 1)
+    # Also the background-precompute cadence (GoalOptimizer.java:107-135):
+    # the facade's precompute daemon refreshes the generation-keyed proposal
+    # cache at this interval.
     d.define("proposal.expiration.ms", ConfigType.LONG, 60_000)
     d.define("goal.violation.distribution.threshold.multiplier",
              ConfigType.DOUBLE, 1.0)

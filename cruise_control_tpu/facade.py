@@ -90,6 +90,7 @@ class CruiseControl:
         notifier=None,
         self_healing_goals: Optional[Sequence[str]] = None,
         anomaly_detection_interval_s: float = 300.0,
+        proposal_precompute_interval_s: float = 0.0,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -106,6 +107,14 @@ class CruiseControl:
                 lambda: task_runner.resume_sampling("executor"))
         self.anomaly_detector = self._build_anomaly_detector(
             self_healing_goals, anomaly_detection_interval_s)
+        # Background proposal precompute (GoalOptimizer.java:137-188): a
+        # daemon refreshing the generation-keyed proposal cache whenever the
+        # model generation moves, so GET /proposals is a cache hit instead of
+        # paying cold-solve latency.  0 disables (tests/offline use).
+        self._precompute_interval_s = proposal_precompute_interval_s
+        self._precompute_stop = threading.Event()
+        self._precompute_thread: Optional[threading.Thread] = None
+        self._precomputed_generation = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -114,11 +123,36 @@ class CruiseControl:
         if self.task_runner is not None:
             self.task_runner.start()
         self.anomaly_detector.start_detection()
+        if self._precompute_interval_s > 0:
+            self._precompute_thread = threading.Thread(
+                target=self._precompute_loop, name="proposal-precompute",
+                daemon=True)
+            self._precompute_thread.start()
 
     def shutdown(self) -> None:
+        self._precompute_stop.set()
+        if self._precompute_thread is not None:
+            self._precompute_thread.join(timeout=5.0)
         self.anomaly_detector.shutdown()
         if self.task_runner is not None:
             self.task_runner.shutdown()
+
+    def _precompute_loop(self) -> None:
+        """ProposalCandidateComputer analog (GoalOptimizer.java:545-592): on
+        each tick, if the model generation advanced and completeness holds,
+        run the default-goal dryrun solve so the cache is warm for readers."""
+        while not self._precompute_stop.wait(self._precompute_interval_s):
+            try:
+                generation = self.load_monitor.model_generation
+                if generation == self._precomputed_generation:
+                    continue
+                if not self.load_monitor.meet_completeness_requirements(
+                        ModelCompletenessRequirements()):
+                    continue
+                self.proposals()
+                self._precomputed_generation = generation
+            except Exception as e:          # noqa: BLE001 — keep the daemon up
+                LOG.warning("proposal precompute failed: %s", e)
 
     def _build_anomaly_detector(self, self_healing_goals,
                                 interval_s) -> AnomalyDetectorManager:

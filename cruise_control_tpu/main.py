@@ -125,7 +125,9 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
         notifier=notifier,
         self_healing_goals=config.goal_names("anomaly.detection.goals"),
         anomaly_detection_interval_s=
-            config["anomaly.detection.interval.ms"] / 1000.0)
+            config["anomaly.detection.interval.ms"] / 1000.0,
+        proposal_precompute_interval_s=
+            config["proposal.expiration.ms"] / 1000.0)
     app = CruiseControlApp(
         cc,
         host=config["webserver.http.address"],

@@ -1,0 +1,76 @@
+"""Per-goal timing/rounds breakdown of the headline bench config.
+
+Usage: python scripts/profile_solve.py [cpu|tpu] [small|big]
+
+Mirrors GoalOptimizer.optimizations goal-by-goal with explicit per-goal
+timing (block_until_ready between goals), after a full warmup pass.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+
+def main() -> None:
+    want = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    size = sys.argv[2] if len(sys.argv) > 2 else "small"
+    from cruise_control_tpu.utils.hermetic import force_cpu, probe_tpu
+    if want != "tpu" or not probe_tpu():
+        force_cpu()
+        backend = "cpu"
+    else:
+        backend = "tpu"
+
+    from bench import GOALS
+
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer.context import build_context
+    from cruise_control_tpu.analyzer.goals.registry import get_goals_by_priority
+    from cruise_control_tpu.analyzer.options import OptimizationOptions
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    if size == "big":
+        props = rc.ClusterProperties(
+            num_brokers=2600, num_racks=40, num_topics=2000,
+            num_replicas=1_000_000, mean_cpu=0.0035, mean_disk=90.0,
+            mean_nw_in=90.0, mean_nw_out=90.0, seed=3141)
+    else:
+        props = rc.ClusterProperties(
+            num_brokers=200, num_racks=10, num_topics=1000,
+            num_replicas=50_000, mean_cpu=0.006, mean_disk=90.0,
+            mean_nw_in=90.0, mean_nw_out=90.0, seed=3140)
+    state, placement, meta = rc.generate(props)
+    optimizer = GoalOptimizer(goal_names=GOALS)
+    goals = get_goals_by_priority(GOALS)
+    gctx = build_context(state, placement, meta, optimizer.constraint,
+                         OptimizationOptions())
+    solver = optimizer.solver
+
+    def one_pass(label, pl):
+        total0 = time.monotonic()
+        priors = []
+        for goal in goals:
+            t0 = time.monotonic()
+            pl, info = solver.optimize_goal(goal, priors, gctx, pl)
+            jax.block_until_ready(pl.broker)
+            dt = time.monotonic() - t0
+            print(f"  {goal.name:44s} {dt*1000:9.1f} ms rounds={info.rounds:3d} "
+                  f"moves={info.moves_applied:6d} "
+                  f"violated {info.violated_brokers_before:4d}->"
+                  f"{info.violated_brokers_after:4d}")
+            priors.append(goal)
+        print(f"{label} total={time.monotonic() - total0:.3f}s")
+        return pl
+
+    print(f"backend={backend} size={size}")
+    print("warmup (compile included):")
+    one_pass("warmup", placement)
+    print("steady-state:")
+    one_pass("steady", placement)
+
+
+if __name__ == "__main__":
+    main()

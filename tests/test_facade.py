@@ -141,3 +141,26 @@ def test_self_healing_toggle():
     cc, *_ = build_stack(self_healing=False)
     assert cc.set_self_healing(AnomalyType.BROKER_FAILURE, True) is False
     assert cc.notifier.self_healing_enabled()[AnomalyType.BROKER_FAILURE] is True
+
+
+def test_background_proposal_precompute_warms_cache():
+    """The precompute daemon (GoalOptimizer.java:137-188 analog) refreshes the
+    generation-keyed proposal cache so a later /proposals read is a hit."""
+    cc, backend, cluster = build_stack()
+    cc._precompute_interval_s = 0.05
+    cc.start_up()
+    try:
+        deadline = time.time() + 10.0
+        while cc._precomputed_generation is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert cc._precomputed_generation is not None
+        gen = cc.load_monitor.model_generation
+        key_gen = cc._precomputed_generation
+        assert key_gen == gen
+        # The cache now serves /proposals without a new solve.
+        assert cc.optimizer._cached, "precompute left no cached result"
+        r = cc.proposals()
+        assert r.optimizer_result is cc.optimizer._cached[
+            next(iter(cc.optimizer._cached))]
+    finally:
+        cc.shutdown()
