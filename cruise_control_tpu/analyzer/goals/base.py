@@ -69,6 +69,11 @@ class Goal:
     # True when multi-swap safety additionally needs at most ONE swap per
     # (topic, broker) touch per round (per-topic count/leader constraints).
     swap_topic_group: bool = False
+    # Multi-leadership: True when this goal's leadership acceptance composes
+    # over several promotions per broker in one round — neutral, or bounded
+    # via ``leadership_cumulative_slack`` below.  False forces the leadership
+    # phase back to one-promotion-per-gaining/losing-broker.
+    multi_leadership_safe: bool = False
 
     def key(self) -> str:
         """Jit-cache key; goals with numeric config should include it here."""
@@ -214,6 +219,20 @@ class Goal:
         """(delta f32[C], upper_slack f32[H]) host-scoped analog (upper bound
         only; same-host swaps are zero-weighted by the solver).  None = no
         host-level constraint."""
+        return None
+
+    # ------------------------------------------- multi-leadership composition
+
+    def leadership_cumulative_slack(self, gctx: GoalContext, placement: Placement,
+                                    agg: Aggregates, f, old):
+        """Optional (delta_gain f32[C], delta_lose f32[C], up_slack f32[B],
+        low_slack f32[B]|None, up_host f32[H]|None): cumulative bound on what
+        each kept promotion adds to the promoted replica f's broker
+        (``delta_gain``, usually > 0) and to the demoted leader ``old``'s
+        broker (``delta_lose``, usually < 0).  The solver checks both brokers'
+        summed positive deltas against ``up_slack`` (and, when given, their
+        hosts against ``up_host``) and summed negative deltas against
+        ``low_slack``.  None = leadership-neutral."""
         return None
 
     # ------------------------------------------------------ pull (move-in)

@@ -29,6 +29,7 @@ class CapacityGoal(Goal):
     is_hard = True
     multi_accept_safe = True
     multi_swap_safe = True
+    multi_leadership_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -105,6 +106,18 @@ class CapacityGoal(Goal):
         limit = gctx.capacity_threshold[res] * gctx.state.capacity[:, res]
         return d_load[:, res], limit - agg.broker_load[:, res], None
 
+    def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
+        res = self.resource
+        if res not in (Resource.CPU, Resource.NW_OUT):
+            return None
+        state = gctx.state
+        dg = state.leader_load[f, res] - state.follower_load[f, res]
+        dl = state.follower_load[old, res] - state.leader_load[old, res]
+        limit = gctx.capacity_threshold[res] * state.capacity[:, res]
+        up_h = (gctx.capacity_threshold[res] * gctx.host_capacity[:, res]
+                - agg.host_load[:, res]) if IS_HOST_RESOURCE[res] else None
+        return dg, dl, limit - agg.broker_load[:, res], None, up_h
+
     def swap_host_cumulative_slack(self, gctx, placement, agg, d_load):
         res = self.resource
         if not IS_HOST_RESOURCE[res]:
@@ -171,7 +184,8 @@ class ReplicaCapacityGoal(Goal):
     name = "ReplicaCapacityGoal"
     is_hard = True
     multi_accept_safe = True
-    multi_swap_safe = True     # swaps are replica-count-neutral
+    multi_swap_safe = True          # swaps are replica-count-neutral
+    multi_leadership_safe = True    # promotions are replica-count-neutral
 
     def violated_brokers(self, gctx, placement, agg):
         alive = alive_mask(gctx)
@@ -226,6 +240,7 @@ class IntraBrokerDiskCapacityGoal(Goal):
     # Inter-broker swaps land on each side's emptiest logdir; the solver's
     # JBOD cumulative fill guard bounds multi-swap arrivals per logdir.
     multi_swap_safe = True
+    multi_leadership_safe = True    # leadership does not move data between disks
 
     def violated_disks(self, gctx, placement, agg):
         limit = gctx.capacity_threshold[Resource.DISK] * gctx.state.disk_capacity

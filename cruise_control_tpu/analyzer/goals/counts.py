@@ -40,7 +40,8 @@ class ReplicaDistributionGoal(Goal):
     has_pull_phase = True
     src_sensitive_accept = True
     multi_accept_safe = True
-    multi_swap_safe = True     # swaps are replica-count-neutral
+    multi_swap_safe = True          # swaps are replica-count-neutral
+    multi_leadership_safe = True    # promotions are replica-count-neutral
 
     def _counts(self, gctx, agg):
         return agg.replica_counts
@@ -146,6 +147,12 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     uses_leadership_moves = True
     has_pull_phase = False
 
+    def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
+        upper, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg).astype(jnp.float32)
+        ones = jnp.ones(jnp.shape(f), dtype=jnp.float32)
+        return ones, -ones, upper - c, c - lower, None
+
     def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot,
                               d_lbi, d_lead):
         """Leader counts shift by is_leader(r_out) - is_leader(r_in)."""
@@ -239,6 +246,7 @@ class TopicReplicaDistributionGoal(Goal):
     # count delta within the +/-1 each pairwise accept_swap already checked.
     multi_swap_safe = True
     swap_topic_group = True
+    multi_leadership_safe = True    # promotions keep per-topic replica counts
 
     def _bounds(self, gctx, agg):
         """(upper i32[T], lower i32[T]) per-topic count bands."""

@@ -24,6 +24,7 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
     # Inter-broker swaps land on each side's emptiest logdir; the solver's
     # JBOD fill guard bounds multi-swap arrivals per logdir.
     multi_swap_safe = True
+    multi_leadership_safe = True   # leadership does not move data between disks
 
     def _bands(self, gctx, agg):
         """(upper f32[B,D], lower f32[B,D]) absolute per-disk load bounds."""

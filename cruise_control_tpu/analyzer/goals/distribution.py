@@ -44,6 +44,7 @@ class ResourceDistributionGoal(Goal):
     src_sensitive_accept = True
     multi_accept_safe = True
     multi_swap_safe = True
+    multi_leadership_safe = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -229,6 +230,16 @@ class ResourceDistributionGoal(Goal):
                               jnp.full_like(load, jnp.inf))
         return d_load[:, res], upper - load, low_slack
 
+    def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
+        """Mirrors accept_leadership_move: positive deltas are held to the
+        upper band (the pairwise check's only bound)."""
+        res = self.resource
+        state = gctx.state
+        dg = state.leader_load[f, res] - state.follower_load[f, res]
+        dl = state.follower_load[old, res] - state.leader_load[old, res]
+        upper, _, _ = self._bounds(gctx, agg)
+        return dg, dl, upper - agg.broker_load[:, res], None, None
+
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Exact pairwise band check: neither end may leave the band in the
         wrong direction once the DELTA (not the full replica load) moves."""
@@ -347,6 +358,7 @@ class PotentialNwOutGoal(Goal):
     is_hard = False
     multi_accept_safe = True
     multi_swap_safe = True
+    multi_leadership_safe = True   # potential NW-out counts every replica as-if-leader
 
     def _limit(self, gctx, b):
         return (gctx.capacity_threshold[Resource.NW_OUT]
@@ -411,6 +423,7 @@ class LeaderBytesInDistributionGoal(Goal):
     uses_leadership_moves = True
     multi_accept_safe = True
     multi_swap_safe = True
+    multi_leadership_safe = True
 
     def _limit(self, gctx, agg):
         alive = alive_mask(gctx)
@@ -466,6 +479,11 @@ class LeaderBytesInDistributionGoal(Goal):
 
     def swap_cumulative_slack(self, gctx, placement, agg, d_load, d_pot, d_lbi, d_lead):
         return d_lbi, self._limit(gctx, agg) - agg.leader_bytes_in, None
+
+    def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
+        nw = gctx.state.leader_load[:, Resource.NW_IN]
+        return (nw[f], -nw[old],
+                self._limit(gctx, agg) - agg.leader_bytes_in, None, None)
 
     def accept_swap(self, gctx, placement, agg, r_out, r_in, b_out, b_in):
         """Only the leader-bytes-in DELTA lands on each end."""

@@ -30,6 +30,7 @@ class PreferredLeaderElectionGoal(Goal):
     name = "PreferredLeaderElectionGoal"
     multi_accept_safe = True
     multi_swap_safe = True     # swaps keep per-replica roles; PLE unaffected
+    multi_leadership_safe = True   # PLE never vetoes (permissive accepts)
     is_hard = False
     is_direct = True
     uses_replica_moves = False
@@ -94,6 +95,11 @@ class MinTopicLeadersPerBrokerGoal(Goal):
     name = "MinTopicLeadersPerBrokerGoal"
     is_hard = True
     src_sensitive_accept = True
+    # Acceptance reads only per-(topic, source) leader counts; one move per
+    # (topic, broker) pair per round keeps each delta within the -1 that the
+    # pairwise acceptance already checked.
+    multi_accept_safe = True
+    needs_topic_group = True
     # One swap per (topic, broker) touch per round keeps each per-topic
     # leader-count delta within the -1 each pairwise acceptance checked.
     multi_swap_safe = True

@@ -50,6 +50,7 @@ class RackAwareGoal(Goal):
     is_hard = True
     multi_accept_safe = True
     multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
+    multi_leadership_safe = True   # leadership never changes rack placement
 
     def violated_brokers(self, gctx, placement, agg):
         viol = replicas_violating_rack(gctx, placement)
@@ -90,6 +91,7 @@ class RackAwareDistributionGoal(Goal):
     is_hard = True
     multi_accept_safe = True
     multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
+    multi_leadership_safe = True   # leadership never changes rack placement
 
     def _rack_cap(self, gctx, r):
         """i32[...]: max allowed replicas of r's partition per rack."""

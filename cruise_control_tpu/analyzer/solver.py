@@ -303,10 +303,14 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                 keep = keep & _cumulative_group_ok(
                     order, dst, keep,
                     [(w, s[dst]) for w, s in dst_cons], c)
-            else:
-                # No in-play headroom math to pack against — fall back to
-                # one arrival per destination per round (the pre-multi rule).
-                keep = keep & _group_winners(order, dst, b)
+            # else: arrivals are UNCAPPED.  Safe by invariant: every
+            # multi_accept_safe goal whose acceptance reads destination
+            # aggregate state declares a dst slack (capacity, counts, bands)
+            # or is protected by the per-(topic, broker) group rule; the
+            # remaining predicates (racks, siblings) are partition-local and
+            # partition uniqueness keeps them exact.  This matters most for
+            # pure-structure goals (RackAware, dead-broker evacuation) where
+            # one-arrival-per-destination would cap a round at B moves.
             # Physical per-logdir fill guard (JBOD): every arrival a broker
             # takes this round gets the SAME pre-round argmin disk, so their
             # cumulative size must fit that logdir's remaining capacity.
@@ -366,6 +370,11 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
 
 def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     accept = _chain_accept_leadership(priors)
+    multi = all(getattr(g, "multi_leadership_safe", False)
+                for g in (goal, *priors))
+    topic_group = any(getattr(g, "needs_topic_group", False)
+                      or getattr(g, "swap_topic_group", False)
+                      for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
         state = gctx.state
@@ -380,15 +389,60 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
         ok = ok & (old >= 0)
         old_safe = jnp.maximum(old, 0)
 
-        # One promotion per partition, per gaining broker, per losing broker.
+        # One promotion per partition always; per gaining/losing broker,
+        # EITHER at most one promotion (fallback) OR — when every in-play
+        # goal composes — as many as the brokers' cumulative load/count
+        # headroom fits (one check over both roles' streams, so a broker
+        # that gains AND loses leadership shares a single budget).
         order = jnp.where(ok, jnp.arange(c, dtype=jnp.int32), c)
         gain_b = placement.broker[cand]
         lose_b = placement.broker[old_safe]
         b = state.num_brokers_padded
         keep = (ok
-                & _group_winners(order, state.partition[cand], gctx.num_partitions)
-                & _group_winners(order, gain_b, b)
-                & _group_winners(order, lose_b, b))
+                & _group_winners(order, state.partition[cand], gctx.num_partitions))
+        if multi:
+            if topic_group:
+                # Promoted follower and demoted leader share the partition
+                # (hence the topic): one touch per (topic, broker) per round.
+                t = state.topic[cand]
+                nseg = gctx.num_topics * b
+                key_g = t * b + gain_b
+                key_l = t * b + lose_b
+                keys2 = jnp.concatenate([key_g, key_l])
+                order_t = jnp.concatenate([order, order])
+                best = jax.ops.segment_min(order_t, keys2, num_segments=nseg)
+                keep = keep & (best[key_g] == order) & (best[key_l] == order)
+            rows = []
+            h_rows = []
+            group2 = jnp.concatenate([gain_b, lose_b])
+            h_group2 = jnp.concatenate([state.host[gain_b], state.host[lose_b]])
+            for g in (goal, *priors):
+                got = g.leadership_cumulative_slack(gctx, placement, agg,
+                                                    cand, old_safe)
+                if got is None:
+                    continue
+                dg, dl, up, low, up_h = got
+                d2 = jnp.concatenate([dg, dl])
+                pos2 = jnp.maximum(d2, 0.0)
+                rows.append((pos2, up[group2]))
+                if low is not None:
+                    rows.append((jnp.maximum(-d2, 0.0), low[group2]))
+                if up_h is not None:
+                    h_rows.append((pos2, up_h[h_group2]))
+            order2 = jnp.concatenate([order * 2, order * 2 + 1])
+            act2 = jnp.concatenate([keep, keep])
+            if rows:
+                ok2 = _cumulative_group_ok(order2, group2, act2, rows, 2 * c)
+                keep = keep & ok2[:c] & ok2[c:]
+            if h_rows:
+                ok2h = _cumulative_group_ok(order2, h_group2,
+                                            jnp.concatenate([keep, keep]),
+                                            h_rows, 2 * c)
+                keep = keep & ok2h[:c] & ok2h[c:]
+        else:
+            keep = (keep
+                    & _group_winners(order, gain_b, b)
+                    & _group_winners(order, lose_b, b))
 
         # Non-kept rows scatter to an out-of-range dummy (mode='drop'): their
         # old_safe values repeat across rows (every non-candidate/padded row

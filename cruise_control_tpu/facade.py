@@ -124,15 +124,21 @@ class CruiseControl:
             self.task_runner.start()
         self.anomaly_detector.start_detection()
         if self._precompute_interval_s > 0:
+            # Non-daemon: a daemon thread killed inside native XLA code at
+            # interpreter exit aborts the process; a non-daemon thread makes
+            # exit wait for the in-flight solve (bounded), then stop cleanly.
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, name="proposal-precompute",
-                daemon=True)
+                daemon=False)
             self._precompute_thread.start()
 
     def shutdown(self) -> None:
         self._precompute_stop.set()
         if self._precompute_thread is not None:
             self._precompute_thread.join(timeout=5.0)
+            if self._precompute_thread.is_alive():
+                LOG.warning("proposal precompute still solving; it will stop "
+                            "after the in-flight solve completes")
         self.anomaly_detector.shutdown()
         if self.task_runner is not None:
             self.task_runner.shutdown()

@@ -8,8 +8,11 @@ timing (block_until_ready between goals), after a full warmup pass.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
