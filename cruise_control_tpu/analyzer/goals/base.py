@@ -22,6 +22,8 @@ apply scan.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.context import (
@@ -74,6 +76,20 @@ class Goal:
     # via ``leadership_cumulative_slack`` below.  False forces the leadership
     # phase back to one-promotion-per-gaining/losing-broker.
     multi_leadership_safe: bool = False
+    # True when multi-leadership safety additionally needs at most ONE
+    # promotion per (topic, broker) touch per round (per-topic LEADER-count
+    # acceptance).  Distinct from needs_topic_group/swap_topic_group, which
+    # protect replica-count acceptances that are leadership-neutral.
+    leadership_topic_group: bool = False
+    # True when this goal's accept_replica_move reads no destination
+    # AGGREGATE state (partition-/source-local predicates only) — exempts it
+    # from the trace-time dst-slack invariant check below.
+    dst_slack_exempt: bool = False
+    # Optional cap on the candidate-tile width for this goal's move phases.
+    # Band-bounded goals keep far fewer moves per round than the structural
+    # goals' default width, so a narrower tile cuts the dominant C×B
+    # feasibility cost without costing rounds.  None = solver default.
+    candidate_width_hint: Optional[int] = None
 
     def key(self) -> str:
         """Jit-cache key; goals with numeric config should include it here."""

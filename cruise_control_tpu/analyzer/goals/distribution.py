@@ -45,6 +45,10 @@ class ResourceDistributionGoal(Goal):
     multi_accept_safe = True
     multi_swap_safe = True
     multi_leadership_safe = True
+    # Band headroom keeps per-round acceptance far below the structural
+    # goals' tile width; 1024 candidates lose no rounds (measured) and cut
+    # the C×B feasibility cost 4x at north-star scale.
+    candidate_width_hint = 1024
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -232,8 +236,11 @@ class ResourceDistributionGoal(Goal):
 
     def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
         """Mirrors accept_leadership_move: positive deltas are held to the
-        upper band (the pairwise check's only bound)."""
+        upper band (the pairwise check's only bound); DISK is leadership-
+        neutral exactly as the pairwise acceptance waives it."""
         res = self.resource
+        if not self.uses_leadership_moves and res != Resource.NW_IN:
+            return None
         state = gctx.state
         dg = state.leader_load[f, res] - state.follower_load[f, res]
         dl = state.follower_load[old, res] - state.leader_load[old, res]

@@ -51,6 +51,7 @@ class RackAwareGoal(Goal):
     multi_accept_safe = True
     multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
     multi_leadership_safe = True   # leadership never changes rack placement
+    dst_slack_exempt = True        # acceptance reads sibling placement, not dst aggregates
 
     def violated_brokers(self, gctx, placement, agg):
         viol = replicas_violating_rack(gctx, placement)
@@ -92,6 +93,7 @@ class RackAwareDistributionGoal(Goal):
     multi_accept_safe = True
     multi_swap_safe = True     # partition-unique swaps cannot interact rack-wise
     multi_leadership_safe = True   # leadership never changes rack placement
+    dst_slack_exempt = True        # acceptance reads sibling placement, not dst aggregates
 
     def _rack_cap(self, gctx, r):
         """i32[...]: max allowed replicas of r's partition per rack."""

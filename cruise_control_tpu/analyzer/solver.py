@@ -233,6 +233,27 @@ def _multi_accept_constraints(goal: Goal, priors: Sequence[Goal], gctx,
     return out
 
 
+def _check_dst_slack_invariant(goal: Goal, priors: Sequence[Goal]) -> None:
+    """Uncapped multi-accept arrivals are safe only if every in-play goal
+    whose replica acceptance reads destination aggregate state bounds those
+    arrivals — via a dst slack, the (topic, broker) group rule, or an
+    explicit partition-/source-local exemption.  Trace-time, so a future
+    goal cannot silently reintroduce the over-arrival hazard."""
+    for g in (goal, *priors):
+        overrides_accept = (type(g).accept_replica_move
+                            is not Goal.accept_replica_move)
+        declares_slack = (type(g).dst_cumulative_slack
+                          is not Goal.dst_cumulative_slack)
+        if (overrides_accept and not declares_slack
+                and not getattr(g, "needs_topic_group", False)
+                and not getattr(g, "dst_slack_exempt", False)):
+            raise ValueError(
+                f"{g.name}: multi_accept_safe goals overriding "
+                "accept_replica_move must declare dst_cumulative_slack, set "
+                "needs_topic_group, or mark dst_slack_exempt (acceptance "
+                "reads no destination aggregates)")
+
+
 def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                    score_fn: Callable, self_ok_fn: Callable,
                    dst_mask_fn: Optional[Callable] = None,
@@ -243,6 +264,8 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
     need_src_cap = _src_sensitive(goal, priors)
     multi_accept = all(getattr(g, "multi_accept_safe", False)
                        for g in (goal, *priors))
+    if multi_accept:
+        _check_dst_slack_invariant(goal, priors)
     needs_topic_group = any(getattr(g, "needs_topic_group", False)
                             for g in (goal, *priors))
 
@@ -372,8 +395,10 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     accept = _chain_accept_leadership(priors)
     multi = all(getattr(g, "multi_leadership_safe", False)
                 for g in (goal, *priors))
-    topic_group = any(getattr(g, "needs_topic_group", False)
-                      or getattr(g, "swap_topic_group", False)
+    # Only goals with per-topic LEADER-count acceptance need the (topic,
+    # broker) single-touch rule here; replica-count topic groups are
+    # leadership-neutral and would needlessly re-cap the batch.
+    topic_group = any(getattr(g, "leadership_topic_group", False)
                       for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
@@ -718,6 +743,10 @@ class GoalSolver:
                                       gctx.state.num_replicas_padded)
         return jax.device_put((gctx, placement), shardings)
 
+    def _width(self, goal: Goal, num_replicas_padded: int) -> int:
+        hint = getattr(goal, "candidate_width_hint", None) or self.max_candidates
+        return min(self.max_candidates, hint, num_replicas_padded)
+
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []
         if getattr(goal, "is_direct", False):
@@ -768,7 +797,7 @@ class GoalSolver:
     def _round_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
         """One jitted solver round (kept for the driver's single-chip
         compile check and for round-granular tests)."""
-        c = min(self.max_candidates, num_replicas_padded)
+        c = self._width(goal, num_replicas_padded)
         key = ("round", goal.key(), tuple(g.key() for g in priors), c)
         if key in self._round_cache:
             return self._round_cache[key]
@@ -787,7 +816,7 @@ class GoalSolver:
         metric) and the condition mirrors the host loop exactly:
         work remains ∧ last round made progress ∧ round budget left.
         """
-        c = min(self.max_candidates, num_replicas_padded)
+        c = self._width(goal, num_replicas_padded)
         key = ("solve", goal.key(), tuple(g.key() for g in priors), c)
         if key in self._round_cache:
             return self._round_cache[key]

@@ -127,6 +127,11 @@ class CruiseControl:
             # Non-daemon: a daemon thread killed inside native XLA code at
             # interpreter exit aborts the process; a non-daemon thread makes
             # exit wait for the in-flight solve (bounded), then stop cleanly.
+            # The atexit hook covers exit paths that never call shutdown()
+            # (uncaught exception, plain return) so the thread cannot keep
+            # the interpreter alive forever.
+            import atexit
+            atexit.register(self._precompute_stop.set)
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, name="proposal-precompute",
                 daemon=False)
