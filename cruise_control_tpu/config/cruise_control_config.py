@@ -149,6 +149,12 @@ def _webserver_def() -> ConfigDef:
     d.define("webserver.auth.trusted.proxy.ips", ConfigType.STRING, "")
     d.define("webserver.auth.trusted.proxy.user.header", ConfigType.STRING,
              "X-Forwarded-User")
+    # TLS listener (reference WebServerConfig WEBSERVER_SSL_* +
+    # KafkaCruiseControlApp.java:100-120): PEM certificate chain + key.
+    d.define("webserver.ssl.enable", ConfigType.BOOLEAN, False)
+    d.define("webserver.ssl.certfile", ConfigType.STRING, "")
+    d.define("webserver.ssl.keyfile", ConfigType.STRING, "")
+    d.define("webserver.ssl.keyfile.password", ConfigType.STRING, "")
     d.define("max.active.user.tasks", ConfigType.INT, 25)
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG, 86_400_000)
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False)

@@ -127,11 +127,10 @@ class CruiseControl:
             # Non-daemon: a daemon thread killed inside native XLA code at
             # interpreter exit aborts the process; a non-daemon thread makes
             # exit wait for the in-flight solve (bounded), then stop cleanly.
-            # The atexit hook covers exit paths that never call shutdown()
-            # (uncaught exception, plain return) so the thread cannot keep
-            # the interpreter alive forever.
-            import atexit
-            atexit.register(self._precompute_stop.set)
+            # The loop also watches main-thread liveness (atexit is no help:
+            # CPython joins non-daemon threads BEFORE atexit callbacks run),
+            # so exit paths that never call shutdown() cannot hang the
+            # interpreter for more than ~a second past the in-flight solve.
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, name="proposal-precompute",
                 daemon=False)
@@ -148,11 +147,26 @@ class CruiseControl:
         if self.task_runner is not None:
             self.task_runner.shutdown()
 
+    def _interruptible_wait(self) -> bool:
+        """True = stop.  Waits the precompute interval in <=1 s slices,
+        stopping early when the stop event fires or the main thread is gone
+        (interpreter finalization joins non-daemon threads before atexit, so
+        liveness polling is the only reliable unattended-exit signal)."""
+        remaining = self._precompute_interval_s
+        while remaining > 0:
+            slice_s = min(1.0, remaining)
+            if self._precompute_stop.wait(slice_s):
+                return True
+            if not threading.main_thread().is_alive():
+                return True
+            remaining -= slice_s
+        return False
+
     def _precompute_loop(self) -> None:
         """ProposalCandidateComputer analog (GoalOptimizer.java:545-592): on
         each tick, if the model generation advanced and completeness holds,
         run the default-goal dryrun solve so the cache is warm for readers."""
-        while not self._precompute_stop.wait(self._precompute_interval_s):
+        while not self._interruptible_wait():
             try:
                 generation = self.load_monitor.model_generation
                 if generation == self._precomputed_generation:

@@ -16,6 +16,7 @@ import sys
 import time
 from typing import Optional
 
+from cruise_control_tpu.common.exceptions import ConfigError
 from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
 from cruise_control_tpu.detector.notifier import SelfHealingNotifier
 from cruise_control_tpu.executor.backend import FakeClusterBackend
@@ -128,13 +129,21 @@ def build_app(config: CruiseControlConfig, demo: bool = True,
             config["anomaly.detection.interval.ms"] / 1000.0,
         proposal_precompute_interval_s=
             config["proposal.expiration.ms"] / 1000.0)
+    ssl_on = config["webserver.ssl.enable"]
+    if ssl_on and not config["webserver.ssl.certfile"]:
+        raise ConfigError(
+            "webserver.ssl.enable=true requires webserver.ssl.certfile — "
+            "refusing to silently serve the control plane over plain HTTP")
     app = CruiseControlApp(
         cc,
         host=config["webserver.http.address"],
         port=port if port is not None else config["webserver.http.port"],
         two_step_verification=config["two.step.verification.enabled"],
         max_active_user_tasks=config["max.active.user.tasks"],
-        security=_security_provider(config))
+        security=_security_provider(config),
+        ssl_certfile=config["webserver.ssl.certfile"] if ssl_on else None,
+        ssl_keyfile=config["webserver.ssl.keyfile"] or None,
+        ssl_keyfile_password=config["webserver.ssl.keyfile.password"] or None)
     return app
 
 

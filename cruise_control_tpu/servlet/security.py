@@ -10,6 +10,11 @@ HashLoginService over a ``realm.properties``-style credentials file),
 asserts the user via header from an allow-listed address).
 
 Everything is stdlib: the server is control-plane and must stay hermetic.
+
+Intentionally absent: the reference's SPNEGO/Kerberos provider
+(``servlet/security/spnego/*``) — it requires a KDC and the JAAS/GSSAPI
+stack; deployments fronting this service with Kerberos should use the
+TrustedProxy provider behind an authenticating proxy instead.
 """
 
 from __future__ import annotations

@@ -110,7 +110,10 @@ class CruiseControlApp:
     def __init__(self, cc: CruiseControl, host: str = "127.0.0.1", port: int = 0,
                  two_step_verification: bool = False,
                  max_active_user_tasks: int = 25,
-                 security=None):
+                 security=None,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None,
+                 ssl_keyfile_password: Optional[str] = None):
         self.cc = cc
         self.user_tasks = UserTaskManager(max_active_tasks=max_active_user_tasks)
         self.purgatory = Purgatory() if two_step_verification else None
@@ -119,6 +122,21 @@ class CruiseControlApp:
         self.security = security
         handler = _make_handler(self)
         self.server = ThreadingHTTPServer((host, port), handler)
+        # TLS listener (KafkaCruiseControlApp.java:100-120 SSL connector):
+        # PEM cert/key via config; requests then ride https.
+        if ssl_certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_certfile, keyfile=ssl_keyfile,
+                                password=ssl_keyfile_password)
+            # Defer the handshake to the per-request handler thread: with
+            # do_handshake_on_connect=True the accept loop performs the full
+            # handshake synchronously, so one stalled client would block
+            # every other connection.
+            self.server.socket = ctx.wrap_socket(
+                self.server.socket, server_side=True,
+                do_handshake_on_connect=False)
+        self.ssl_enabled = bool(ssl_certfile)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -229,3 +229,38 @@ def test_cli_parameter_validation():
                 ["admin", "--enable_self_healing_for", "bogus"]):
         with pytest.raises(SystemExit):
             parser.parse_args(bad)
+
+
+@pytest.mark.skipif(__import__("shutil").which("openssl") is None,
+                    reason="openssl CLI not available")
+def test_ssl_listener(tmp_path):
+    """TLS listener (KafkaCruiseControlApp.java:100-120 SSL connector): a
+    https request against a self-signed cert succeeds; plain http does not."""
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+
+    cc, backend, cluster = build_stack()
+    app = CruiseControlApp(cc, port=0, ssl_certfile=str(cert),
+                           ssl_keyfile=str(key))
+    app.start()
+    try:
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        url = f"https://127.0.0.1:{app.port}/kafkacruisecontrol/state"
+        body = json.load(urllib.request.urlopen(url, context=ctx, timeout=10))
+        assert "MonitorState" in body
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/kafkacruisecontrol/state",
+                timeout=5)
+    finally:
+        app.stop()
