@@ -145,7 +145,12 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
 
     name = "LeaderReplicaDistributionGoal"
     uses_leadership_moves = True
-    has_pull_phase = False
+    # Leader replicas pulled INTO under-count brokers (the reference's
+    # rebalanceByMovingLeaderReplicasIn fallback).
+    has_pull_phase = True
+    # Count-band headroom keeps rounds narrower than the default tile, but
+    # the under-fill pull needs reach (1024 measurably loses residuals).
+    candidate_width_hint = 2048
 
     def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
         upper, lower = self._bounds(gctx, agg)
@@ -188,16 +193,35 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         return ~is_lead | (dst_ok & src_ok)
 
     def leadership_candidate_score(self, gctx, placement, agg):
+        """Promotions serve BOTH band ends: shed over-count brokers (promote
+        their partitions' followers elsewhere) and fill under-count brokers
+        (promote their own followers, demoting donors that stay above the
+        lower band)."""
         state = gctx.state
+        _, lower = self._bounds(gctx, agg)
+        c = self._counts(gctx, agg)
         over = self._over_brokers(gctx, agg)
+        under = self.pull_dst_mask(gctx, placement, agg)
         f = jnp.arange(state.num_replicas_padded)
         lead = current_leader_of(gctx, placement, state.partition[f])
         lb = placement.broker[jnp.maximum(lead, 0)]
-        c = self._counts(gctx, agg)
-        cand = ((lead >= 0) & over[lb] & ~placement.is_leader & state.valid
+        b = placement.broker
+        base = ((lead >= 0) & ~placement.is_leader & state.valid
                 & ~currently_offline(gctx, placement) & ~gctx.replica_excluded)
-        # Prefer promoting onto the emptiest brokers.
-        return jnp.where(cand, -c[placement.broker].astype(jnp.float32), NEG_INF)
+        cand_over = base & over[lb]
+        cand_under = base & under[b] & (c[lb] - 1 >= lower)
+        # Under-fill tier strictly above the over-shed tier (counts are
+        # bounded by R, so the tiers stay disjoint and f32-exact), then
+        # prefer promoting onto the emptiest brokers within each tier.
+        rmax = jnp.float32(state.num_replicas_padded)
+        score = (under[b].astype(jnp.float32) * 2.0 * rmax
+                 + (rmax - c[b].astype(jnp.float32)))
+        return jnp.where(cand_over | cand_under, score, NEG_INF)
+
+    def pull_candidate_score(self, gctx, placement, agg):
+        """Only LEADER replicas carry leader counts into an under broker."""
+        base = super().pull_candidate_score(gctx, placement, agg)
+        return jnp.where(placement.is_leader, base, NEG_INF)
 
     def leadership_self_ok(self, gctx, placement, agg, f):
         upper, _ = self._bounds(gctx, agg)

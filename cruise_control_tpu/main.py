@@ -185,9 +185,10 @@ def main(argv=None) -> int:
     app = build_app(config, demo=True, port=args.port)
     app.cc.start_up()
     app.start()
+    scheme = "https" if app.ssl_enabled else "http"
     print(f"cruise-control-tpu listening on "
-          f"http://{config['webserver.http.address']}:{app.port}"
-          f"{'' } (demo cluster)", flush=True)
+          f"{scheme}://{config['webserver.http.address']}:{app.port}"
+          " (demo cluster)", flush=True)
     stop = [False]
     signal.signal(signal.SIGTERM, lambda *a: stop.__setitem__(0, True))
     signal.signal(signal.SIGINT, lambda *a: stop.__setitem__(0, True))
