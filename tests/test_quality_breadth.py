@@ -129,3 +129,21 @@ def test_jitter_frac_sweep():
     assert rounds_full <= rounds_greedy, outcomes
     # Quality: within 15% of the greedy CV (absolute floor for tiny CVs).
     assert cv_full <= cv_greedy * 1.15 + 0.01, outcomes
+
+
+def test_full_stack_goal_convergence():
+    """Every default goal's per-goal solve converges (violated -> 0, with a
+    small tolerated residual on the leader-count goal) on a mid-size random
+    cluster — the regression ratchet for the multi-accept/multi-swap/
+    multi-leadership batching machinery."""
+    props = rc.ClusterProperties(num_brokers=40, num_racks=4, num_topics=60,
+                                 num_replicas=6000, mean_cpu=0.006,
+                                 seed=11)
+    state, placement, meta = rc.generate(props)
+    res = GoalOptimizer().optimizations(state, placement, meta)
+    for info in res.goal_infos:
+        limit = 2 if info.goal_name == "LeaderReplicaDistributionGoal" else 0
+        assert info.violated_brokers_after <= limit, (
+            f"{info.goal_name}: {info.violated_brokers_before} -> "
+            f"{info.violated_brokers_after} violated after "
+            f"{info.rounds} rounds / {info.moves_applied} moves")
