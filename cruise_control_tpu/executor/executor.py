@@ -31,6 +31,10 @@ from cruise_control_tpu.executor.tasks import (
 )
 
 LOG = logging.getLogger(__name__)
+# Dedicated operation audit log (reference OPERATION_LOGGER,
+# KafkaCruiseControlUtils / Executor.java:945): execution lifecycle events on
+# their own logger name so deployments can route them to an audit sink.
+OPERATION_LOG = logging.getLogger("cruisecontrol.operation")
 
 
 class ExecutorState(enum.Enum):
@@ -197,7 +201,18 @@ class Executor:
             total = min(len(proposals), self.config.max_num_cluster_movements)
             for t in self._planner.add_proposals(list(proposals)[:total]):
                 self.tracker.add(t)
+            # Audit-log deltas are against this execution's start (the
+            # tracker itself is lifetime-cumulative).
+            self._exec_baseline = (
+                {st: sum(self.tracker.count(t, st) for t in TaskType)
+                 for st in (ExecutionTaskState.COMPLETED,
+                            ExecutionTaskState.DEAD,
+                            ExecutionTaskState.ABORTED)},
+                self.tracker.finished_data_movement_mb)
         self._sensor_started.inc()
+        OPERATION_LOG.info(
+            "execution started: %d tasks (%d proposals requested, cap %d)",
+            total, len(proposals), self.config.max_num_cluster_movements)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="proposal-execution")
         self._thread.start()
@@ -213,6 +228,7 @@ class Executor:
                 self._state = ExecutorState.STOPPING_EXECUTION
                 self._stop_requested.set()
                 self._sensor_stopped.inc()
+                OPERATION_LOG.info("execution stop requested (user=%s)", user)
                 if user:
                     self._sensor_stopped_by_user.inc()
 
@@ -261,6 +277,19 @@ class Executor:
                 self._resume_sampling()
             with self._lock:
                 self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            base_counts, base_mb = self._exec_baseline
+            counts = {st: sum(self.tracker.count(t, st) for t in TaskType)
+                      - base_counts[st]
+                      for st in (ExecutionTaskState.COMPLETED,
+                                 ExecutionTaskState.DEAD,
+                                 ExecutionTaskState.ABORTED)}
+            OPERATION_LOG.info(
+                "execution finished: completed=%d dead=%d aborted=%d "
+                "moved=%.1fMB",
+                counts[ExecutionTaskState.COMPLETED],
+                counts[ExecutionTaskState.DEAD],
+                counts[ExecutionTaskState.ABORTED],
+                self.tracker.finished_data_movement_mb - base_mb)
             for fn in self._on_finish:
                 try:
                     fn()
