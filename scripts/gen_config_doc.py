@@ -1,0 +1,46 @@
+"""Regenerate docs/CONFIGURATION.md from the live ConfigDef."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cruise_control_tpu.utils.hermetic import force_cpu
+
+force_cpu()
+
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+
+HEADER = """# Configuration reference
+
+Key names match the reference's `cruisecontrol.properties` (a reference
+properties file parses directly; goal lists also accept fully-qualified Java
+class names).  Generated from `cruise_control_tpu/config/cruise_control_config.py`
+by `scripts/gen_config_doc.py`.
+
+| Key | Type | Default | Notes |
+|---|---|---|---|
+"""
+
+
+def main() -> None:
+    cfg = CruiseControlConfig()
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "CONFIGURATION.md")
+    with open(out, "w") as f:
+        f.write(HEADER)
+        for name in sorted(cfg.definition._keys):
+            k = cfg.definition._keys[name]
+            dv = "" if k.default is None else str(k.default)
+            if len(dv) > 60:
+                dv = dv[:57] + "..."
+            f.write(f"| `{name}` | {k.config_type.value} "
+                    f"| `{dv.replace('|', chr(92) + '|')}` "
+                    f"| {(k.doc or '').replace('|', chr(92) + '|')} |\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
