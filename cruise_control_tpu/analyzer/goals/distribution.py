@@ -35,6 +35,13 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
 
 
+def _hash01_1d(r: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic pseudo-uniform [0,1) per replica index (the 1-D case of
+    the solver's pair-jitter hash)."""
+    from cruise_control_tpu.analyzer.solver import _hash01
+    return _hash01(r, jnp.ones((), dtype=jnp.float32))
+
+
 class ResourceDistributionGoal(Goal):
     """Keep one resource's per-broker utilization inside the balance band."""
 
@@ -161,34 +168,49 @@ class ResourceDistributionGoal(Goal):
                 & ~currently_offline(gctx, placement))
 
     def swap_out_score(self, gctx, placement, agg):
-        """Heavy replicas first, with a strong bonus for replicas sitting on
-        OVER-band brokers — the swap tiles must contain the violated brokers'
-        replicas or the phase cannot fix them (capacity-fraction units)."""
+        """Shedding-side tile: replicas on above-average brokers, with each
+        broker's expected tile share proportional to how far above average it
+        sits (gap-weighted random interleave) and a mild heaviness tilt."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
         cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
-        hot = (agg.broker_load[:, res] > avg * cap) & alive_mask(gctx)
-        upper, _, _ = self._bounds(gctx, agg)
-        over_gap = jnp.maximum(agg.broker_load[:, res] - upper, 0.0) / cap
+        load = agg.broker_load[:, res]
+        hot = (load > avg * cap) & alive_mask(gctx)
+        height = jnp.maximum(load / cap - avg, 0.0)
         prio = self.replica_priority(gctx, placement, agg)
         b = placement.broker
         cand = hot[b] & self._swap_base_mask(gctx, placement)
-        return jnp.where(cand, 8.0 * over_gap[b] + prio / cap[b], NEG_INF)
+        # Gap-weighted random interleave: each replica draws
+        # height[broker] * U(0,1), so a broker's expected tile share grows
+        # with how far above average it sits WITHOUT the worst broker
+        # monopolizing the tile (a deterministic gap bonus collapses the
+        # 1024-slot tile onto ~3 brokers at north-star scale; a binary tier
+        # starves the worst ones — both measured).  Within the tile, pair
+        # choice is swap_cost's argmin, so per-replica ordering can be
+        # random; a mild heaviness tilt keeps deltas meaningful.
+        r = jnp.arange(gctx.state.num_replicas_padded)
+        u = 0.25 + 0.75 * _hash01_1d(r)
+        tilt = 1.0 + prio / jnp.maximum(
+            jnp.max(prio * (prio < 1e29)), 1e-9)
+        return jnp.where(cand, height[b] * u * tilt, NEG_INF)
 
     def swap_in_score(self, gctx, placement, agg):
-        """Light replicas first, with a strong bonus for replicas on
-        UNDER-band brokers (their broker must receive swapped-in load)."""
+        """Receiving-side tile: replicas on below-average brokers, with each
+        broker's expected tile share proportional to how far below average it
+        sits (gap-weighted random interleave; pair choice within the tile is
+        swap_cost's argmin)."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
         cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
-        cold = (agg.broker_load[:, res] < avg * cap) & alive_mask(gctx)
-        _, lower, lower_active = self._bounds(gctx, agg)
-        under_gap = jnp.where(
-            lower_active, jnp.maximum(lower - agg.broker_load[:, res], 0.0), 0.0) / cap
-        prio = self.replica_priority(gctx, placement, agg)
+        load = agg.broker_load[:, res]
+        cold = (load < avg * cap) & alive_mask(gctx)
+        depth = jnp.maximum(avg - load / cap, 0.0)
         b = placement.broker
         cand = cold[b] & self._swap_base_mask(gctx, placement)
-        return jnp.where(cand, 8.0 * under_gap[b] - prio / cap[b], NEG_INF)
+        # Gap-weighted random interleave (see swap_out_score).
+        r = jnp.arange(gctx.state.num_replicas_padded)
+        u = 0.25 + 0.75 * _hash01_1d(r)
+        return jnp.where(cand, depth[b] * u, NEG_INF)
 
     def _swap_after(self, gctx, placement, agg, r_out, r_in):
         """(delta, b_out, b_in, load-after both sides) for the pair tile."""

@@ -31,8 +31,7 @@ def main() -> None:
         os.path.abspath(__file__))), "docs", "CONFIGURATION.md")
     with open(out, "w") as f:
         f.write(HEADER)
-        for name in sorted(cfg.definition._keys):
-            k = cfg.definition._keys[name]
+        for name, k in sorted(cfg.definition.keys().items()):
             dv = "" if k.default is None else str(k.default)
             if len(dv) > 60:
                 dv = dv[:57] + "..."
