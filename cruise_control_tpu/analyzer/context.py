@@ -37,6 +37,16 @@ from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 NEG_INF = -jnp.inf
 
 
+def hash01(a: jnp.ndarray, b) -> jnp.ndarray:
+    """Deterministic pseudo-uniform [0,1) from two index/seed arrays
+    (broadcast).  The solver's tie-breaking jitter and the swap tiles'
+    weighted-random interleave both ride this."""
+    x = jnp.sin(jnp.asarray(a).astype(jnp.float32) * 12.9898
+                + jnp.asarray(b).astype(jnp.float32) * 78.233)
+    v = x * 43758.5453
+    return v - jnp.floor(v)
+
+
 @flax.struct.dataclass
 class GoalContext:
     """Per-optimization constants (traced, but never change across rounds)."""

@@ -189,12 +189,14 @@ class Goal:
     # in-candidates, a C×C pair-feasibility matrix, conflict-free selection.
 
     def swap_out_score(self, gctx: GoalContext, placement: Placement,
-                       agg: Aggregates) -> jnp.ndarray:
-        """f32[R]: -inf = not a swap-out candidate; higher = try first."""
+                       agg: Aggregates, salt) -> jnp.ndarray:
+        """f32[R]: -inf = not a swap-out candidate; higher = try first.
+        ``salt`` (round index) reseeds any randomized interleave so a draw
+        is never frozen across rounds."""
         return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
 
     def swap_in_score(self, gctx: GoalContext, placement: Placement,
-                      agg: Aggregates) -> jnp.ndarray:
+                      agg: Aggregates, salt) -> jnp.ndarray:
         """f32[R]: -inf = not a swap-in candidate; higher = try first."""
         return jnp.full(gctx.state.num_replicas_padded, NEG_INF)
 

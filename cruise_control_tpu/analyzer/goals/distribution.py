@@ -22,6 +22,7 @@ from cruise_control_tpu.analyzer.context import (
     GoalContext,
     current_leader_of,
     currently_offline,
+    hash01,
     replica_role_load,
 )
 from cruise_control_tpu.analyzer.goals.base import (
@@ -33,13 +34,6 @@ from cruise_control_tpu.analyzer.goals.base import (
 )
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
-
-
-def _hash01_1d(r: jnp.ndarray) -> jnp.ndarray:
-    """Deterministic pseudo-uniform [0,1) per replica index (the 1-D case of
-    the solver's pair-jitter hash)."""
-    from cruise_control_tpu.analyzer.solver import _hash01
-    return _hash01(r, jnp.ones((), dtype=jnp.float32))
 
 
 class ResourceDistributionGoal(Goal):
@@ -167,10 +161,11 @@ class ResourceDistributionGoal(Goal):
         return (state.valid & ~gctx.replica_excluded
                 & ~currently_offline(gctx, placement))
 
-    def swap_out_score(self, gctx, placement, agg):
+    def swap_out_score(self, gctx, placement, agg, salt):
         """Shedding-side tile: replicas on above-average brokers, with each
         broker's expected tile share proportional to how far above average it
-        sits (gap-weighted random interleave) and a mild heaviness tilt."""
+        sits (gap-weighted random interleave, reseeded per round) and a mild
+        heaviness tilt."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
         cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
@@ -189,16 +184,15 @@ class ResourceDistributionGoal(Goal):
         # choice is swap_cost's argmin, so per-replica ordering can be
         # random; a mild heaviness tilt keeps deltas meaningful.
         r = jnp.arange(gctx.state.num_replicas_padded)
-        u = 0.25 + 0.75 * _hash01_1d(r)
-        tilt = 1.0 + prio / jnp.maximum(
-            jnp.max(prio * (prio < 1e29)), 1e-9)
+        u = 0.25 + 0.75 * hash01(r + salt * 7919, 1.0)
+        tilt = 1.0 + prio / jnp.maximum(jnp.max(prio), 1e-9)
         return jnp.where(cand, height[b] * u * tilt, NEG_INF)
 
-    def swap_in_score(self, gctx, placement, agg):
+    def swap_in_score(self, gctx, placement, agg, salt):
         """Receiving-side tile: replicas on below-average brokers, with each
         broker's expected tile share proportional to how far below average it
-        sits (gap-weighted random interleave; pair choice within the tile is
-        swap_cost's argmin)."""
+        sits (gap-weighted random interleave, reseeded per round; pair choice
+        within the tile is swap_cost's argmin)."""
         res = self.resource
         avg = avg_alive_util_fraction(gctx, agg, res)
         cap = jnp.maximum(gctx.state.capacity[:, res], 1e-9)
@@ -209,7 +203,7 @@ class ResourceDistributionGoal(Goal):
         cand = cold[b] & self._swap_base_mask(gctx, placement)
         # Gap-weighted random interleave (see swap_out_score).
         r = jnp.arange(gctx.state.num_replicas_padded)
-        u = 0.25 + 0.75 * _hash01_1d(r)
+        u = 0.25 + 0.75 * hash01(r + salt * 7919, 1.0)
         return jnp.where(cand, depth[b] * u, NEG_INF)
 
     def _swap_after(self, gctx, placement, agg, r_out, r_in):

@@ -47,6 +47,7 @@ from cruise_control_tpu.analyzer.context import (
     compute_aggregates,
     current_leader_of,
     currently_offline,
+    hash01,
     replica_role_load,
 )
 from cruise_control_tpu.analyzer.goals.base import Goal
@@ -141,29 +142,19 @@ def _group_winners(order_key: jnp.ndarray, group: jnp.ndarray,
     return best[group] == order_key
 
 
-def _hash01(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Deterministic pseudo-uniform [0,1) from two index arrays (broadcast).
-
-    Destination tie-breaker: without it every candidate's argmin lands on the
-    single emptiest broker and the one-move-per-destination rule collapses
-    the batch to one move per round.
-    """
-    x = jnp.sin(a.astype(jnp.float32) * 12.9898 + b.astype(jnp.float32) * 78.233)
-    v = x * 43758.5453
-    return v - jnp.floor(v)
-
-
 def _jittered(cost: jnp.ndarray, ok: jnp.ndarray, cand: jnp.ndarray,
-              d2: jnp.ndarray, frac: float = 1.0) -> jnp.ndarray:
+              d2: jnp.ndarray, ridx, frac: float = 1.0) -> jnp.ndarray:
     """Add per-(candidate, dst) jitter scaled to each candidate's feasible
     cost range so the batch spreads over every acceptable destination instead
     of piling onto the single argmin (the feasibility mask already bounds
-    quality: every candidate destination satisfies self_ok + acceptance)."""
+    quality: every candidate destination satisfies self_ok + acceptance).
+    ``ridx`` (round index) reseeds the draw each round so an unlucky draw is
+    never permanent across a zero-progress round."""
     lo = jnp.min(jnp.where(ok, cost, jnp.inf), axis=1, keepdims=True)
     hi = jnp.max(jnp.where(ok, cost, -jnp.inf), axis=1, keepdims=True)
     span = jnp.where(hi > lo, hi - lo, 0.0)
     scale = frac * span + 1e-6
-    return cost + _hash01(cand[:, None], d2) * scale
+    return cost + hash01(cand[:, None] + ridx * 7919, d2) * scale
 
 
 def _src_sensitive(goal: Goal, priors: Sequence[Goal]) -> bool:
@@ -269,7 +260,8 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
     needs_topic_group = any(getattr(g, "needs_topic_group", False)
                             for g in (goal, *priors))
 
-    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
+              ridx):
         state = gctx.state
         b = state.num_brokers_padded
         c = num_candidates
@@ -294,7 +286,7 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         ranked = jnp.argsort(proxy).astype(jnp.int32)        # cheap → expensive
         assign = ranked[jnp.arange(c, dtype=jnp.int32) % b]
         ok_assign = jnp.take_along_axis(ok, assign[:, None], axis=1)[:, 0]
-        jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2,
+        jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2, ridx,
                                         frac=jitter_frac), _INF_COST)
         fallback = jnp.argmin(jcost, axis=1).astype(jnp.int32)
         dst = jnp.where(ok_assign, assign, fallback)
@@ -401,7 +393,9 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     topic_group = any(getattr(g, "leadership_topic_group", False)
                       for g in (goal, *priors))
 
-    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
+              ridx):
+        del ridx    # promotions carry no tie-breaking jitter
         state = gctx.state
         c = num_candidates
         score = goal.leadership_candidate_score(gctx, placement, agg)
@@ -508,14 +502,17 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                       or getattr(g, "swap_topic_group", False)
                       for g in (goal, *priors))
 
-    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
+              ridx):
         state = gctx.state
         c = num_candidates
         b = state.num_brokers_padded
-        out_top, out_c = _top_candidates(goal.swap_out_score(gctx, placement, agg),
-                                         c, exact=goal.is_hard)
-        in_top, in_c = _top_candidates(goal.swap_in_score(gctx, placement, agg),
-                                       c, exact=goal.is_hard)
+        out_top, out_c = _top_candidates(
+            goal.swap_out_score(gctx, placement, agg, ridx), c,
+            exact=goal.is_hard)
+        in_top, in_c = _top_candidates(
+            goal.swap_in_score(gctx, placement, agg, ridx), c,
+            exact=goal.is_hard)
 
         ro = out_c[:, None]                      # [C,1]
         ri = in_c[None, :]                       # [1,C]
@@ -530,7 +527,7 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         # Partner jitter spreads rows over distinct in-partners (otherwise
         # many rows argmin onto the same partner and uniqueness drops them).
         pos = jnp.arange(c, dtype=jnp.int32)[None, :]
-        cost = jnp.where(ok, _jittered(cost_raw, ok, out_c, pos,
+        cost = jnp.where(ok, _jittered(cost_raw, ok, out_c, pos, ridx,
                                        frac=jitter_frac), _INF_COST)
         sel = jnp.argmin(cost, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(ok, sel[:, None], axis=1)[:, 0]
@@ -662,7 +659,9 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
 
 
 def _intra_disk_phase(goal: Goal, num_candidates: int):
-    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates):
+    def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
+              ridx):
+        del ridx
         state = gctx.state
         d_n = state.num_disks_per_broker
         c = num_candidates
@@ -753,7 +752,8 @@ class GoalSolver:
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []
         if getattr(goal, "is_direct", False):
-            def direct(gctx, placement, agg, _goal=goal):
+            def direct(gctx, placement, agg, ridx, _goal=goal):
+                del ridx
                 new_pl = _goal.direct_apply(gctx, placement, agg)
                 changed = jnp.sum((new_pl.is_leader != placement.is_leader)
                                   .astype(jnp.int32)) // 2
@@ -783,11 +783,11 @@ class GoalSolver:
     def _round_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = self._phases(goal, priors, c)
 
-        def round_body(gctx: GoalContext, placement: Placement):
+        def round_body(gctx: GoalContext, placement: Placement, ridx):
             agg = compute_aggregates(gctx, placement)
             applied = jnp.int32(0)
             for phase in phases:
-                placement, agg, n = phase(gctx, placement, agg)
+                placement, agg, n = phase(gctx, placement, agg, ridx)
                 applied = applied + n
             violated = jnp.sum(goal.violated_brokers(gctx, placement, agg)
                                .astype(jnp.int32))
@@ -857,7 +857,8 @@ class GoalSolver:
 
             def body(carry):
                 pl, rounds, _, moves, _, _, _, best_work, best_metric, stall = carry
-                pl, applied, violated, stranded, metric = round_body(gctx, pl)
+                pl, applied, violated, stranded, metric = round_body(
+                    gctx, pl, rounds)
                 work_now = violated + stranded
                 improved = ((work_now < best_work)
                             | (metric < best_metric
