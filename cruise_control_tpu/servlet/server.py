@@ -71,25 +71,43 @@ def _ints(params: Dict[str, str], name: str) -> List[int]:
     return [int(x) for x in raw.split(",") if x.strip()]
 
 
-def _goals(params: Dict[str, str]) -> Optional[List[str]]:
+def _restricted_goals(names: List[str], allowed: List[str],
+                      label: str) -> List[str]:
+    """Empty request → the full allowed list; otherwise reject names outside
+    it and keep the allowed list's canonical order."""
+    if not names:
+        return list(allowed)
+    bad = [n for n in names if n not in allowed]
+    if bad:
+        raise UserRequestError(
+            f"goals {bad} are not {label} goals (allowed: {allowed})")
+    return [g for g in allowed if g in names]
+
+
+def _goals(params: Dict[str, str],
+           allow_rebalance_disk: bool = False) -> Optional[List[str]]:
     """Requested goal list; ``kafka_assigner=true`` swaps in the assigner
-    pair (reference RunnableUtils.java isKafkaAssignerMode), honoring an
-    explicit assigner-goal subset and rejecting non-assigner goals (the
-    reference's sanityCheckOptimizationOptions)."""
+    pair (reference RunnableUtils.java isKafkaAssignerMode) and — on the
+    rebalance endpoint only, as in RebalanceParameters —
+    ``rebalance_disk=true`` swaps in the intra-broker goal list; explicit
+    subsets are validated against the mode's allowed set (the reference's
+    sanityCheckOptimizationOptions)."""
     raw = params.get("goals", "")
     names = [g.strip().rsplit(".", 1)[-1] for g in raw.split(",") if g.strip()]
+    if allow_rebalance_disk and _bool(params, "rebalance_disk", False):
+        from cruise_control_tpu.analyzer.goals.registry import (
+            DEFAULT_INTRA_BROKER_GOALS,
+        )
+        if _bool(params, "kafka_assigner", False):
+            raise UserRequestError(
+                "rebalance_disk and kafka_assigner are mutually exclusive")
+        return _restricted_goals(names, DEFAULT_INTRA_BROKER_GOALS,
+                                 "intra-broker")
     if _bool(params, "kafka_assigner", False):
         from cruise_control_tpu.analyzer.goals.registry import KAFKA_ASSIGNER_GOALS
-        if not names:
-            return list(KAFKA_ASSIGNER_GOALS)
-        bad = [n for n in names if n not in KAFKA_ASSIGNER_GOALS]
-        if bad:
-            raise UserRequestError(
-                f"goals {bad} are not kafka_assigner goals "
-                f"(allowed: {KAFKA_ASSIGNER_GOALS})")
         # Canonical order: the even goal must run before the disk goal (it
         # assumes no prior optimized goals).
-        return [g for g in KAFKA_ASSIGNER_GOALS if g in names]
+        return _restricted_goals(names, KAFKA_ASSIGNER_GOALS, "kafka_assigner")
     return names or None
 
 
@@ -309,7 +327,7 @@ class CruiseControlApp:
                            lambda: self.cc.proposals(goals, options))
 
     def _ep_rebalance(self, params, task_id):
-        goals = _goals(params)
+        goals = _goals(params, allow_rebalance_disk=True)
         dryrun = _bool(params, "dryrun", True)
         options = _options(params)
         return self._async("rebalance", params, task_id,

@@ -264,3 +264,24 @@ def test_ssl_listener(tmp_path):
                 timeout=5)
     finally:
         app.stop()
+
+
+def test_rebalance_disk_uses_intra_broker_goals(app):
+    """rebalance_disk=true swaps in the intra-broker goal list (reference
+    RebalanceParameters) and rejects mixing with kafka_assigner."""
+    status, body, headers = _post(app, "rebalance", dryrun="true",
+                                  rebalance_disk="true")
+    task_id = headers.get(USER_TASK_HEADER)
+    deadline = time.time() + 60
+    while status == 202 and time.time() < deadline:
+        time.sleep(0.1)
+        status, body, headers = _post(
+            app, "rebalance", headers={USER_TASK_HEADER: task_id},
+            dryrun="true", rebalance_disk="true")
+    assert status == 200
+    goals_run = [g["goal"] for g in body["result"]["goals"]]
+    assert goals_run == ["IntraBrokerDiskCapacityGoal",
+                         "IntraBrokerDiskUsageDistributionGoal"]
+    status, body, _ = _post(app, "rebalance", dryrun="true",
+                            rebalance_disk="true", kafka_assigner="true")
+    assert status == 400
