@@ -128,6 +128,11 @@ def _timed(fn) -> float:
 def run(backend: str) -> None:
     from cruise_control_tpu.analyzer import GoalOptimizer
     from cruise_control_tpu.testing import random_cluster as rc
+    from cruise_control_tpu.utils.hermetic import (
+        enable_persistent_compilation_cache,
+    )
+
+    cache_warm = enable_persistent_compilation_cache()
 
     # ---- config #3 (headline) first, so a number exists even if the harness
     # cuts the run short; re-emitted last for tail parsers.
@@ -181,7 +186,8 @@ def run(backend: str) -> None:
           batch_s, backend, value_per_lane=round(batch_s / lanes, 4),
           per_lane_vs_budget=round(
               NORTH_STAR_BUDGET_S / max(batch_s / lanes, 1e-9), 3),
-          lanes=lanes, includes_compile=True)
+          lanes=lanes, includes_compile=True,
+          compile_cache="warm" if cache_warm else "cold")
     del h_state, h_placement, opt_hard
 
     # Headline repeated LAST: the driver's artifact parser takes the tail line.

@@ -20,7 +20,12 @@ import jax
 def main() -> None:
     want = sys.argv[1] if len(sys.argv) > 1 else "tpu"
     size = sys.argv[2] if len(sys.argv) > 2 else "small"
-    from cruise_control_tpu.utils.hermetic import force_cpu, probe_tpu
+    from cruise_control_tpu.utils.hermetic import (
+        enable_persistent_compilation_cache,
+        force_cpu,
+        probe_tpu,
+    )
+    cache_warm = enable_persistent_compilation_cache()
     if want != "tpu" or not probe_tpu():
         force_cpu()
         backend = "cpu"
@@ -69,7 +74,8 @@ def main() -> None:
         return pl
 
     print(f"backend={backend} size={size}")
-    print("warmup (compile included):")
+    print("warmup (%s):" % ("persistent-cache read"
+                              if cache_warm else "compile included"))
     one_pass("warmup", placement)
     print("steady-state:")
     one_pass("steady", placement)
