@@ -128,11 +128,12 @@ def _timed(fn) -> float:
 def run(backend: str) -> None:
     from cruise_control_tpu.analyzer import GoalOptimizer
     from cruise_control_tpu.testing import random_cluster as rc
-    from cruise_control_tpu.utils.hermetic import (
-        enable_persistent_compilation_cache,
-    )
-
-    cache_warm = enable_persistent_compilation_cache()
+    # NOTE: the persistent compilation cache is deliberately NOT enabled
+    # here: on this VM, XLA:CPU detects different machine features across
+    # processes and warns that loading mismatched AOT results "could lead to
+    # execution errors such as SIGILL" — the benchmark artifact must never
+    # die to a stale cache entry.  (scripts/profile_solve.py opts in.)
+    cache_warm = False
 
     # ---- config #3 (headline) first, so a number exists even if the harness
     # cuts the run short; re-emitted last for tail parsers.

@@ -149,6 +149,7 @@ class GoalOptimizer:
         goal_names: Optional[Sequence[str]] = None,
         solver: Optional[GoalSolver] = None,
         mesh=None,
+        polish_passes: int = 1,
     ):
         self.constraint = constraint or BalancingConstraint()
         self.goal_names = list(goal_names or DEFAULT_GOALS)
@@ -168,6 +169,9 @@ class GoalOptimizer:
                 max_candidates_per_round=self.constraint.max_candidates_per_round,
                 max_rounds_per_goal=self.constraint.max_rounds_per_goal,
             )
+        # Post-stack re-solve passes for re-violated soft goals (0 disables;
+        # part of the proposal-cache key).
+        self.polish_passes = polish_passes
         self._cache_lock = threading.Lock()
         self._cached: Dict[Tuple, OptimizerResult] = {}
 
@@ -189,7 +193,8 @@ class GoalOptimizer:
         if model_generation is not None:
             effective_names = (tuple(g.name for g in goals) if goals is not None
                                else tuple(self.goal_names))
-            cache_key = (model_generation, effective_names, options)
+            cache_key = (model_generation, effective_names, options,
+                         self.polish_passes)
             with self._cache_lock:
                 hit = self._cached.get(cache_key)
             if hit is not None:
@@ -260,6 +265,34 @@ class GoalOptimizer:
             priors.append(goal)
         prov_under.set(0)
         prov_right.set(1)
+
+        # Polish pass: a later goal's moves may RE-violate an earlier SOFT
+        # goal's band (hard goals are protected by the acceptance chains).
+        # Re-solve each re-violated soft goal with EVERY other goal as a
+        # prior, so the fix cannot disturb anything else — the sequential
+        # reference ends with whatever its single pass produced; this ends
+        # strictly better.  Goals that never satisfied their band in their
+        # OWN pass are excluded: re-solving them cannot improve anything and
+        # would pay a fresh all-but-self compile for nothing.
+        satisfied_own_pass = {i.goal_name for i in infos
+                              if i.violated_brokers_after == 0}
+        for _ in range(self.polish_passes):
+            aggP = compute_aggregates(gctx, placement)
+            revio = [g for g in goals
+                     if not g.is_hard and g.name in satisfied_own_pass
+                     and int(np.sum(np.asarray(
+                         g.violated_brokers(gctx, placement, aggP)))) > 0]
+            if not revio:
+                break
+            for goal in revio:
+                placement, pinfo = self.solver.optimize_goal(
+                    goal, [p for p in goals if p is not goal], gctx, placement)
+                for i, inf in enumerate(infos):
+                    if inf.goal_name == goal.name:
+                        inf.rounds += pinfo.rounds
+                        inf.moves_applied += pinfo.moves_applied
+                        inf.violated_brokers_after = pinfo.violated_brokers_after
+                        inf.metric_after = pinfo.metric_after
 
         aggN = compute_aggregates(gctx, placement)
         violated_after = [

@@ -62,12 +62,15 @@ def enable_persistent_compilation_cache(path: Optional[str] = None) -> bool:
     path is per-user (a world-shared /tmp dir would silently no-op for the
     second user).  Returns True when the cache already holds entries
     ("warm") so callers can annotate timing artifacts."""
-    import getpass
     import jax
 
     if path is None:
-        user = getpass.getuser() or "nouser"
-        path = f"/tmp/cruise_control_tpu_jax_cache_{user}"
+        # Under the user's own cache root (not a predictable /tmp name a
+        # co-tenant could pre-create or poison with attacker-compiled code).
+        root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache")
+        path = os.path.join(root, "cruise_control_tpu", "jax_cache")
+        os.makedirs(path, exist_ok=True)
     warm = False
     try:
         warm = os.path.isdir(path) and any(os.scandir(path))

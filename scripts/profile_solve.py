@@ -74,8 +74,10 @@ def main() -> None:
         return pl
 
     print(f"backend={backend} size={size}")
-    print("warmup (%s):" % ("persistent-cache read"
-                              if cache_warm else "compile included"))
+    # cache_warm only says the cache DIR holds entries (possibly for a
+    # different backend/size) — the label stays neutral.
+    print("warmup (compile or cache read; cache dir %s):"
+          % ("non-empty" if cache_warm else "empty"))
     one_pass("warmup", placement)
     print("steady-state:")
     one_pass("steady", placement)
