@@ -147,3 +147,7 @@ def test_full_stack_goal_convergence():
             f"{info.goal_name}: {info.violated_brokers_before} -> "
             f"{info.violated_brokers_after} violated after "
             f"{info.rounds} rounds / {info.moves_applied} moves")
+    # With the post-stack polish pass, the FINAL state satisfies every goal
+    # (the sequential reference ships whatever its single pass produced).
+    assert res.violated_goals_after == [], res.violated_goals_after
+    assert res.balancedness_score == 100.0
