@@ -142,8 +142,7 @@ def test_full_stack_goal_convergence():
     state, placement, meta = rc.generate(props)
     res = GoalOptimizer().optimizations(state, placement, meta)
     for info in res.goal_infos:
-        limit = 2 if info.goal_name == "LeaderReplicaDistributionGoal" else 0
-        assert info.violated_brokers_after <= limit, (
+        assert info.violated_brokers_after == 0, (
             f"{info.goal_name}: {info.violated_brokers_before} -> "
             f"{info.violated_brokers_after} violated after "
             f"{info.rounds} rounds / {info.moves_applied} moves")
