@@ -132,10 +132,10 @@ def test_jitter_frac_sweep():
 
 
 def test_full_stack_goal_convergence():
-    """Every default goal's per-goal solve converges (violated -> 0, with a
-    small tolerated residual on the leader-count goal) on a mid-size random
-    cluster — the regression ratchet for the multi-accept/multi-swap/
-    multi-leadership batching machinery."""
+    """Every default goal's per-goal solve converges to zero violated
+    brokers on a mid-size random cluster, and the polished final state
+    satisfies every goal — the regression ratchet for the multi-accept/
+    multi-swap/multi-leadership batching machinery."""
     props = rc.ClusterProperties(num_brokers=40, num_racks=4, num_topics=60,
                                  num_replicas=6000, mean_cpu=0.006,
                                  seed=11)
