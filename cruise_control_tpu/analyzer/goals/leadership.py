@@ -19,7 +19,9 @@ from cruise_control_tpu.analyzer.context import (
 from cruise_control_tpu.analyzer.goals.base import Goal, NEG_INF, alive_mask
 from cruise_control_tpu.model.state import Placement
 
-_BIG = jnp.int32(1 << 30)
+# Plain int: a module-level jnp scalar would initialize the JAX backend
+# at IMPORT time, before callers can force the CPU platform.
+_BIG = 1 << 30
 
 
 class PreferredLeaderElectionGoal(Goal):

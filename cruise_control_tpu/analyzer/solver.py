@@ -56,7 +56,8 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
 
 _SCORE_FLOOR = -1e29  # candidate scores below this are "not a candidate"
-_INF_COST = jnp.float32(3.4e38)
+# Plain float (see leadership.py _BIG): no backend init at import.
+_INF_COST = 3.4e38
 
 
 def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False):

@@ -50,8 +50,11 @@ def demo_metadata(num_brokers: int = 6, num_partitions: int = 48,
     return FakeMetadataBackend(brokers, parts)
 
 
-def build_app(config: CruiseControlConfig, demo: bool = True,
+def build_app(config: CruiseControlConfig,
               port: Optional[int] = None) -> CruiseControlApp:
+    """Wire the full stack against the in-process demo cluster (the role of
+    the reference's embedded-broker harness); real deployments substitute
+    the metadata/admin/sampler seams."""
     backend = demo_metadata()
     metadata_client = MetadataClient(backend,
                                      ttl_ms=config["metadata.max.age.ms"])
@@ -178,11 +181,43 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument("--demo", action="store_true",
                         help="run against the in-process fake cluster")
+    parser.add_argument("--platform", choices=("auto", "tpu", "cpu"),
+                        default="auto",
+                        help="JAX backend: auto probes the TPU tunnel with a "
+                             "timeout and falls back to CPU (a wedged tunnel "
+                             "would otherwise hang the first solve); cpu "
+                             "forces the host platform; tpu uses the default "
+                             "backend unconditionally")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    import os
+    if args.platform == "cpu":
+        from cruise_control_tpu.utils.hermetic import force_cpu
+        force_cpu()
+    elif args.platform == "auto":
+        from cruise_control_tpu.utils.hermetic import force_cpu, probe_tpu
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # Env-pinned CPU is a deliberate choice, not a TPU outage —
+            # no warning, but still deregister the tunnel plugin.
+            force_cpu()
+        elif not probe_tpu():
+            logging.getLogger(__name__).warning(
+                "TPU backend unavailable; falling back to CPU")
+            force_cpu()
+    if not args.demo:
+        # The in-process fake cluster is the only bundled cluster backend;
+        # real-cluster deployments implement the MetadataBackend /
+        # AdminBackend / MetricSampler seams (monitor/metadata.py,
+        # executor/backend.py, monitor/sampler.py) and wire them in their
+        # own bootstrap.  Refuse to silently serve the demo cluster.
+        parser.error("only --demo mode ships a cluster backend; for a real "
+                     "cluster, wire your MetadataBackend/AdminBackend/"
+                     "MetricSampler implementations via the seams in "
+                     "monitor/metadata.py, executor/backend.py and "
+                     "monitor/sampler.py")
     config = (CruiseControlConfig.from_properties_file(args.config)
               if args.config else CruiseControlConfig())
-    app = build_app(config, demo=True, port=args.port)
+    app = build_app(config, port=args.port)
     app.cc.start_up()
     app.start()
     scheme = "https" if app.ssl_enabled else "http"

@@ -182,7 +182,7 @@ def test_reporter_mode_service_pipeline():
         "partition.metrics.window.ms": 400,
         "num.metric.fetchers": 3,
     })
-    app = build_app(cfg, demo=True, port=0)
+    app = build_app(cfg, port=0)
     app.cc.start_up()
     try:
         import time

@@ -109,7 +109,7 @@ def secured_app():
         "webserver.security.enable": True,
         "webserver.auth.credentials.file": path,
     })
-    app = build_app(cfg, demo=True, port=0)
+    app = build_app(cfg, port=0)
     app.cc.start_up()
     app.start()
     yield app

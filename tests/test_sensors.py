@@ -47,7 +47,7 @@ def test_service_sensor_surface():
 
     cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
                                "partition.metrics.window.ms": 600})
-    app = build_app(cfg, demo=True, port=0)
+    app = build_app(cfg, port=0)
     app.cc.start_up()
     app.start()
     try:
