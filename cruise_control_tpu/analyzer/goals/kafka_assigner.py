@@ -136,16 +136,53 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
     # --------------------------------------------------------------- rounds
 
     def violated_brokers(self, gctx, placement, agg):
+        """Rack conflicts, dead brokers holding replicas, and FIXABLE
+        count-band overflow.
+
+        The reference's asserted postconditions are only
+        ``ensureNoOfflineReplicas`` + ``ensureRackAware``
+        (KafkaAssignerEvenRackAwareGoal.java:142-145); position-evenness is
+        its greedy TreeSet *heuristic* — and cannot be a hard bound: a rack
+        with fewer brokers (DeterministicCluster racks {0,0,1}) holds one
+        replica of EVERY partition, forcing its brokers over any even band.
+        What the greedy does guarantee is the absence of a surplus replica
+        that some rack-eligible under-ceiling broker could absorb — so that,
+        and only that, is what counts as an evenness violation here."""
+        state = gctx.state
         eff = self._eff_pos(gctx, placement)
         counts = self._pos_counts(gctx, placement, eff)
         upper, _ = self._bounds(gctx, counts)
-        over = jnp.any(counts > upper[:, None], axis=0) & alive_mask(gctx)
-        dead_with = ((~gctx.state.alive) & gctx.state.broker_valid
+        b = state.num_brokers_padded
+        k = gctx.num_racks
+
+        # under[p, k]: rack k has an alive broker below the position-p ceiling.
+        alive = alive_mask(gctx)
+        can_take = alive[None, :] & (counts + 1 <= upper[:, None])     # [RF,B]
+        # segment_SUM: an empty rack segment must read False (segment_max's
+        # empty-segment identity is INT32_MIN, which casts to True).
+        under = (jax.ops.segment_sum(
+            can_take.astype(jnp.int32).T, state.rack,
+            num_segments=k).T > 0)                                     # [RF,K]
+
+        # blocked[r, k]: a LOWER-position sibling of r occupies rack k.
+        r = jnp.arange(state.num_replicas_padded)
+        sibs = gctx.partition_replicas[state.partition]                # [R,RF]
+        safe = jnp.maximum(sibs, 0)
+        is_sib = (sibs >= 0) & (sibs != r[:, None])
+        lower = is_sib & (eff[safe] < eff[:, None])
+        sib_rack = jnp.where(lower, state.rack[placement.broker[safe]], k)
+        blocked = jnp.zeros((state.num_replicas_padded, k + 1), dtype=bool)
+        blocked = blocked.at[r[:, None], sib_rack].set(True)[:, :k]    # [R,K]
+
+        over_r = (counts[eff, placement.broker] > upper[eff]) & state.valid
+        fixable = over_r & jnp.any(under[eff] & ~blocked, axis=-1)
+
+        dead_with = ((~state.alive) & state.broker_valid
                      & (agg.replica_counts > 0))
         conflict = self._rack_conflict(gctx, placement, eff)
-        b = gctx.state.num_brokers_padded
-        conflict_b = jnp.zeros(b, dtype=bool).at[placement.broker].max(conflict)
-        return over | dead_with | conflict_b
+        flag_r = fixable | conflict
+        flagged_b = jnp.zeros(b, dtype=bool).at[placement.broker].max(flag_r)
+        return dead_with | flagged_b
 
     def candidate_score(self, gctx, placement, agg):
         state = gctx.state

@@ -92,7 +92,13 @@ def _current_leaders(gctx: GoalContext, placement: Placement) -> jnp.ndarray:
 
 class MinTopicLeadersPerBrokerGoal(Goal):
     """Each alive broker leads ≥ N partitions of each configured topic
-    (MinTopicLeadersPerBrokerGoal.java).  No configured topics → no-op."""
+    (MinTopicLeadersPerBrokerGoal.java).  No configured topics → no-op.
+
+    Two mechanisms, like the reference: promote an existing follower on a
+    deficit broker (``MinTopicLeadersPerBrokerGoal.java:333``,
+    LEADERSHIP_MOVEMENT), and — when the deficit broker holds no promotable
+    follower at all (e.g. an empty broker) — move a surplus broker's leader
+    replica onto it (``:360,430``, INTER_BROKER_REPLICA_MOVEMENT)."""
 
     name = "MinTopicLeadersPerBrokerGoal"
     is_hard = True
@@ -106,7 +112,13 @@ class MinTopicLeadersPerBrokerGoal(Goal):
     # leader-count delta within the -1 each pairwise acceptance checked.
     multi_swap_safe = True
     swap_topic_group = True
-    uses_replica_moves = False
+    # Same argument for batched leadership promotions: acceptance and
+    # self-checks read only per-(topic, broker) leader counts, and the
+    # (topic, broker) single-touch rule in the multi-leadership path caps
+    # every pair's per-round delta at the ±1 those predicates evaluated.
+    multi_leadership_safe = True
+    leadership_topic_group = True
+    uses_replica_moves = True
     uses_leadership_moves = True
 
     def _deficit(self, gctx, agg):
@@ -142,6 +154,40 @@ class MinTopicLeadersPerBrokerGoal(Goal):
         t = gctx.state.topic[f]
         b = placement.broker[f]
         return self._deficit(gctx, agg)[t, b] > 0
+
+    def candidate_score(self, gctx, placement, agg):
+        """Leader replicas of relevant topics on surplus brokers, when their
+        topic still has a deficit broker somewhere — the replica-movement
+        fallback for deficit brokers no promotion can reach."""
+        state = gctx.state
+        deficit = self._deficit(gctx, agg)                    # i32[T, B]
+        topic_needs = jnp.any(deficit > 0, axis=1)            # bool[T]
+        t = state.topic
+        src = placement.broker
+        surplus = (agg.topic_leader_counts[t, src]
+                   - gctx.min_topic_leaders)                  # i32[R]
+        cand = (placement.is_leader & state.valid & ~gctx.replica_excluded
+                & ~currently_offline(gctx, placement)
+                & gctx.min_leader_topic_mask[t] & topic_needs[t]
+                & (surplus > 0))
+        # Richest sources shed first (most headroom above the minimum).
+        return jnp.where(cand, surplus.astype(jnp.float32), NEG_INF)
+
+    def self_ok(self, gctx, placement, agg, r, dst):
+        r = jnp.asarray(r)
+        t = gctx.state.topic[r]
+        src = placement.broker[r]
+        deficit = self._deficit(gctx, agg)
+        donor_ok = (agg.topic_leader_counts[t, src] - 1
+                    >= gctx.min_topic_leaders)
+        return (deficit[t, jnp.asarray(dst)] > 0) & donor_ok
+
+    def dst_cost(self, gctx, placement, agg, r, dst):
+        """Deepest deficit first; the default load tiebreak would spread a
+        topic's spare leaders to already-satisfied brokers."""
+        r = jnp.asarray(r)
+        t = gctx.state.topic[r]
+        return -self._deficit(gctx, agg)[t, jnp.asarray(dst)].astype(jnp.float32)
 
     def accept_leadership_move(self, gctx, placement, agg, f):
         """Later goals may not demote a leader off a broker already at minimum."""

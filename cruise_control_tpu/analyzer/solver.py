@@ -60,7 +60,8 @@ _SCORE_FLOOR = -1e29  # candidate scores below this are "not a candidate"
 _INF_COST = 3.4e38
 
 
-def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False):
+def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False,
+                    force_exact=None):
     """(values, indices) of the ~k best-scoring rows, descending.
 
     ``lax.approx_max_k`` lowers to the TPU PartialReduce op — much faster
@@ -70,10 +71,23 @@ def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False):
     HARD goals pass ``exact=True`` — approx misses are deterministic, so a
     shadowed-but-fixable candidate could repeat a zero-move round and turn
     the progress-based loop exit into a spurious OptimizationFailureError.
+
+    ``force_exact`` (traced bool or None) covers the soft-goal edge of the
+    same determinism trap: once the stall counter is running, approx recall
+    misses repeat identically each round, so a shadowed-but-fixable
+    candidate could ride the stall cutoff into a silently-accepted residual.
+    Soft-goal solves pass ``stall > 0`` so every stalled round gets one
+    exact pass before the cutoff can fire.
     """
     if exact or k >= score.shape[-1]:
         return jax.lax.top_k(score, k)
-    return jax.lax.approx_max_k(score, k, recall_target=0.95)
+    if force_exact is None:
+        return jax.lax.approx_max_k(score, k, recall_target=0.95)
+    return jax.lax.cond(
+        force_exact,
+        lambda s: jax.lax.top_k(s, k),
+        lambda s: jax.lax.approx_max_k(s, k, recall_target=0.95),
+        score)
 
 
 @dataclass
@@ -262,12 +276,13 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                             for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
-              ridx):
+              ridx, force_exact=None):
         state = gctx.state
         b = state.num_brokers_padded
         c = num_candidates
         score = score_fn(gctx, placement, agg)
-        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
+                                          force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
@@ -395,12 +410,13 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
                       for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
-              ridx):
+              ridx, force_exact=None):
         del ridx    # promotions carry no tie-breaking jitter
         state = gctx.state
         c = num_candidates
         score = goal.leadership_candidate_score(gctx, placement, agg)
-        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
+                                          force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
 
         ok = (is_cand & accept(gctx, placement, agg, cand)
@@ -504,16 +520,16 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                       for g in (goal, *priors))
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
-              ridx):
+              ridx, force_exact=None):
         state = gctx.state
         c = num_candidates
         b = state.num_brokers_padded
         out_top, out_c = _top_candidates(
             goal.swap_out_score(gctx, placement, agg, ridx), c,
-            exact=goal.is_hard)
+            exact=goal.is_hard, force_exact=force_exact)
         in_top, in_c = _top_candidates(
             goal.swap_in_score(gctx, placement, agg, ridx), c,
-            exact=goal.is_hard)
+            exact=goal.is_hard, force_exact=force_exact)
 
         ro = out_c[:, None]                      # [C,1]
         ri = in_c[None, :]                       # [1,C]
@@ -578,7 +594,14 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                      - placement.is_leader[r_in_sel] * lnwin[r_in_sel])
             d_lead = (placement.is_leader[out_c].astype(jnp.float32)
                       - placement.is_leader[r_in_sel].astype(jnp.float32))
-            in_rows, out_rows = [], []
+            # Both role streams share ONE cumulative check per broker: swap
+            # tiles today draw gainers and shedders from disjoint broker sets
+            # (above- vs below-average), but a broker appearing in both
+            # streams must not spend its up/low slack once per role, so the
+            # check is structural, not an invariant to trip over later
+            # (mirrors the host- and leadership-phase budgets).
+            b_rows = []
+            b_group2 = jnp.concatenate([b_in_sel, b_out_row])
             for g in (goal, *priors):
                 got = g.swap_cumulative_slack(gctx, placement, agg,
                                               d_load, d_pot, d_lbi, d_lead)
@@ -587,17 +610,16 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                 delta, up, low = got
                 p_w = jnp.maximum(delta, 0.0)
                 n_w = jnp.maximum(-delta, 0.0)
-                in_rows.append((p_w, up[b_in_sel]))
-                out_rows.append((n_w, up[b_out_row]))
+                b_rows.append((jnp.concatenate([p_w, n_w]), up[b_group2]))
                 if low is not None:
-                    in_rows.append((n_w, low[b_in_sel]))
-                    out_rows.append((p_w, low[b_out_row]))
-            if in_rows:
-                keep = keep & _cumulative_group_ok(order, b_in_sel, keep,
-                                                   in_rows, c)
-            if out_rows:
-                keep = keep & _cumulative_group_ok(order, b_out_row, keep,
-                                                   out_rows, c)
+                    b_rows.append((jnp.concatenate([n_w, p_w]),
+                                   low[b_group2]))
+            if b_rows:
+                b_order2 = jnp.concatenate([order * 2, order * 2 + 1])
+                b_act2 = jnp.concatenate([keep, keep])
+                ok_b = _cumulative_group_ok(b_order2, b_group2, b_act2,
+                                            b_rows, 2 * c)
+                keep = keep & ok_b[:c] & ok_b[c:]
             # Host-scoped bounds (upper only; same-host swaps are neutral).
             # Both role streams share ONE check per host — a host holding a
             # hot AND a cold broker must not absorb its slack once per role.
@@ -661,13 +683,14 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
 
 def _intra_disk_phase(goal: Goal, num_candidates: int):
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
-              ridx):
+              ridx, force_exact=None):
         del ridx
         state = gctx.state
         d_n = state.num_disks_per_broker
         c = num_candidates
         score = goal.disk_candidate_score(gctx, placement, agg)
-        top_score, cand = _top_candidates(score, c, exact=goal.is_hard)
+        top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
+                                          force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
 
         r2 = cand[:, None]
@@ -753,8 +776,9 @@ class GoalSolver:
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []
         if getattr(goal, "is_direct", False):
-            def direct(gctx, placement, agg, ridx, _goal=goal):
-                del ridx
+            def direct(gctx, placement, agg, ridx, force_exact=None,
+                       _goal=goal):
+                del ridx, force_exact
                 new_pl = _goal.direct_apply(gctx, placement, agg)
                 changed = jnp.sum((new_pl.is_leader != placement.is_leader)
                                   .astype(jnp.int32)) // 2
@@ -784,11 +808,13 @@ class GoalSolver:
     def _round_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = self._phases(goal, priors, c)
 
-        def round_body(gctx: GoalContext, placement: Placement, ridx):
+        def round_body(gctx: GoalContext, placement: Placement, ridx,
+                       force_exact=None):
             agg = compute_aggregates(gctx, placement)
             applied = jnp.int32(0)
             for phase in phases:
-                placement, agg, n = phase(gctx, placement, agg, ridx)
+                placement, agg, n = phase(gctx, placement, agg, ridx,
+                                          force_exact)
                 applied = applied + n
             violated = jnp.sum(goal.violated_brokers(gctx, placement, agg)
                                .astype(jnp.int32))
@@ -858,8 +884,12 @@ class GoalSolver:
 
             def body(carry):
                 pl, rounds, _, moves, _, _, _, best_work, best_metric, stall = carry
+                # Stalled soft-goal rounds retry with exact top-k so a
+                # deterministic approx recall miss can't silently ride the
+                # stall cutoff into an accepted residual (see _top_candidates).
+                force = (stall > 0) if use_stall_cutoff else None
                 pl, applied, violated, stranded, metric = round_body(
-                    gctx, pl, rounds)
+                    gctx, pl, rounds, force)
                 work_now = violated + stranded
                 improved = ((work_now < best_work)
                             | (metric < best_metric

@@ -178,3 +178,137 @@ def rack_aware_unsatisfiable() -> ClusterModel:
     cm.create_replica(T1, 0, broker_id=2, index=2, is_leader=False)
     cm.set_replica_load(T1, 0, 2, load(60.0, 100.0, 130.0, 75.0))
     return cm
+
+
+# ---------------------------------------------------------------- deck models
+# (DeterministicCluster.smallClusterModel / mediumClusterModel — the models
+# DeterministicClusterTest.java:137-199 sweeps across balance percentages,
+# capacity thresholds and broker capacities.)
+
+TOPIC_A, TOPIC_B, TOPIC_C, TOPIC_D = "A", "B", "C", "D"
+# TestConstants.TOPIC_MUST_HAVE_LEADER_REPLICAS_ON_BROKERS
+TOPIC_L = "must_have_leader_replica_on_broker_topic"
+TOPIC0, TOPIC1 = "topic0", "topic1"
+
+# TestConstants.java:36-42 sweep values.
+ZERO_BALANCE_PERCENTAGE = 1.00
+LOW_BALANCE_PERCENTAGE = 1.05
+MEDIUM_BALANCE_PERCENTAGE = 1.25
+HIGH_BALANCE_PERCENTAGE = 1.65
+HIGH_CAPACITY_THRESHOLD = 0.9
+MEDIUM_CAPACITY_THRESHOLD = 0.8
+LOW_CAPACITY_THRESHOLD = 0.7
+
+
+def small_cluster_model(capacity: Optional[Dict[Resource, float]] = None) -> ClusterModel:
+    """DeterministicCluster.smallClusterModel:678-714 — 3 brokers / 2 racks,
+    5 partitions x RF2 over topics T1, T2."""
+    cm = homogeneous_cluster(RACK_BY_BROKER, capacity=capacity)
+    deck = [
+        # (topic, partition, leader broker, leader load, follower broker, follower load)
+        (T1, 0, 0, (20.0, 100.0, 130.0, 75.0), 2, (5.0, 100.0, 0.0, 75.0)),
+        (T1, 1, 1, (15.0, 90.0, 110.0, 55.0), 0, (4.5, 90.0, 0.0, 55.0)),
+        (T2, 0, 1, (5.0, 5.0, 6.0, 5.0), 2, (4.0, 5.0, 0.0, 5.0)),
+        (T2, 1, 0, (25.0, 25.0, 45.0, 55.0), 2, (10.5, 25.0, 0.0, 55.0)),
+        (T2, 2, 0, (20.0, 45.0, 120.0, 95.0), 1, (8.0, 45.0, 0.0, 95.0)),
+    ]
+    for topic, part, lb, lload, fb, fload in deck:
+        cm.create_replica(topic, part, broker_id=lb, index=0, is_leader=True)
+        cm.create_replica(topic, part, broker_id=fb, index=1, is_leader=False)
+        cm.set_replica_load(topic, part, lb, load(*lload))
+        cm.set_replica_load(topic, part, fb, load(*fload))
+    return cm
+
+
+def medium_cluster_model(capacity: Optional[Dict[Resource, float]] = None) -> ClusterModel:
+    """DeterministicCluster.mediumClusterModel:799-842 — 3 brokers / 2 racks,
+    6 partitions x RF2 over topics A, B, C, D."""
+    cm = homogeneous_cluster(RACK_BY_BROKER, capacity=capacity)
+    deck = [
+        (TOPIC_A, 0, 1, (5.0, 4.0, 10.0, 10.0), 0, (5.0, 5.0, 0.0, 4.0)),
+        (TOPIC_A, 1, 0, (5.0, 3.0, 10.0, 8.0), 2, (3.0, 4.0, 0.0, 6.0)),
+        (TOPIC_A, 2, 0, (5.0, 2.0, 10.0, 6.0), 2, (4.0, 5.0, 0.0, 3.0)),
+        (TOPIC_B, 0, 1, (5.0, 4.0, 10.0, 7.0), 2, (2.0, 2.0, 0.0, 5.0)),
+        (TOPIC_C, 0, 2, (1.0, 8.0, 10.0, 4.0), 1, (5.0, 6.0, 0.0, 4.0)),
+        (TOPIC_D, 0, 1, (5.0, 5.0, 10.0, 6.0), 2, (2.0, 8.0, 0.0, 7.0)),
+    ]
+    for topic, part, lb, lload, fb, fload in deck:
+        cm.create_replica(topic, part, broker_id=lb, index=0, is_leader=True)
+        cm.create_replica(topic, part, broker_id=fb, index=1, is_leader=False)
+        cm.set_replica_load(topic, part, lb, load(*lload))
+        cm.set_replica_load(topic, part, fb, load(*fload))
+    return cm
+
+
+# ------------------------------------------------- min-topic-leaders fixtures
+# (DeterministicCluster.minLeaderReplicaPerBroker*:300-545; the goal must fix
+# them with leadership moves where possible and replica moves where not.)
+
+_HALF = (TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+         MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+
+
+def _leader_topic_cluster(assignments) -> ClusterModel:
+    """assignments: iterable of (topic, partition, [(broker, is_leader), ...])."""
+    cm = homogeneous_cluster(RACK_BY_BROKER2)
+    for topic, part, replicas in assignments:
+        for idx, (broker, is_leader) in enumerate(replicas):
+            cm.create_replica(topic, part, broker_id=broker, index=idx,
+                              is_leader=is_leader)
+            cm.set_replica_load(topic, part, broker, load(*_HALF))
+    return cm
+
+
+def min_leader_satisfiable() -> ClusterModel:
+    """B0: P0_l, P1_l; B1: P2_l, P0_f; B2: P2_f, P1_f (:347-380)."""
+    return _leader_topic_cluster([
+        (TOPIC_L, 0, [(0, True), (1, False)]),
+        (TOPIC_L, 1, [(0, True), (2, False)]),
+        (TOPIC_L, 2, [(1, True), (2, False)]),
+    ])
+
+
+def min_leader_satisfiable2() -> ClusterModel:
+    """B0 leads everything; B1/B2 hold followers (:392-430)."""
+    return _leader_topic_cluster([
+        (TOPIC_L, 0, [(0, True), (2, False)]),
+        (TOPIC_L, 1, [(0, True), (1, False)]),
+        (TOPIC_L, 2, [(0, True), (2, False)]),
+    ])
+
+
+def min_leader_satisfiable3() -> ClusterModel:
+    """Four brokers (B0 EMPTY), 16 partitions x RF2; min 4 leaders/broker
+    forces replica MOVES onto B0 — promotions alone cannot reach it
+    (:496-545)."""
+    cm = ClusterModel()
+    for broker_id, rack in sorted(RACK_BY_BROKER3.items()):
+        cm.create_broker(rack=str(rack), host=f"h{broker_id}", broker_id=broker_id,
+                         capacity=dict(BROKER_CAPACITY))
+    placement = {i: (1, 3) for i in range(4)}        # leader B1, follower B3
+    placement.update({i: (2, 1) for i in range(4, 10)})   # leader B2, follower B1
+    placement.update({i: (3, 2) for i in range(10, 16)})  # leader B3, follower B2
+    for part, (lb, fb) in placement.items():
+        cm.create_replica(TOPIC_L, part, broker_id=lb, index=0, is_leader=True)
+        cm.create_replica(TOPIC_L, part, broker_id=fb, index=1, is_leader=False)
+        cm.set_replica_load(TOPIC_L, part, lb, load(*_HALF))
+        cm.set_replica_load(TOPIC_L, part, fb, load(*_HALF))
+    return cm
+
+
+def min_leader_satisfiable4() -> ClusterModel:
+    """Two topics x 3 partitions, all leaders on B0, all followers on B1,
+    B2 empty (:439-492) — needs both promotions and replica moves."""
+    return _leader_topic_cluster([
+        (topic, part, [(0, True), (1, False)])
+        for topic in (TOPIC0, TOPIC1) for part in range(3)
+    ])
+
+
+def min_leader_unsatisfiable() -> ClusterModel:
+    """Two leader replicas, three brokers: pigeonhole failure (:314-334,
+    DeterministicClusterTest.java:229-232 expects OptimizationFailureException)."""
+    return _leader_topic_cluster([
+        (TOPIC_L, 0, [(0, True), (2, False)]),
+        (TOPIC_L, 1, [(0, True), (1, False)]),
+    ])

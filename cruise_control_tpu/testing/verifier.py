@@ -93,8 +93,10 @@ def execute_goals_for(
                     f"{info.goal_name} metric worsened "
                     f"{info.metric_before:.6g} -> {info.metric_after:.6g}"))
 
-    if "NEW_BROKERS" in verifications:
+    if "NEW_BROKERS" in verifications and bool(np.asarray(state.new_broker).any()):
         # Replicas may only move TO new brokers; old brokers keep originals.
+        # Vacuous without new brokers (OptimizationVerifier.java:188 gates on
+        # !clusterModel.newBrokers().isEmpty()).
         new_broker = np.asarray(state.new_broker)
         moved = (np.asarray(final.broker) != np.asarray(state.orig_broker))
         moved &= np.asarray(state.valid)

@@ -161,6 +161,18 @@ def test_background_proposal_precompute_warms_cache():
         # generation may have advanced DURING the precompute solve, so only
         # same-generation identity is asserted, not daemon-vs-now equality).
         cc.task_runner.pause_sampling("test")
+        # Pause stops NEW sampling ticks but not one already in flight; wait
+        # for the model generation to settle or the two reads below can
+        # straddle a generation bump and legitimately miss the cache (seen
+        # once on the 1-core box where recompiles stretch the window).
+        settle_deadline = time.time() + 30.0
+        g = cc.load_monitor.model_generation
+        while time.time() < settle_deadline:
+            time.sleep(0.1)
+            g2 = cc.load_monitor.model_generation
+            if g2 == g:
+                break
+            g = g2
         r1 = cc.proposals()
         r2 = cc.proposals()
         assert r2.optimizer_result is r1.optimizer_result
