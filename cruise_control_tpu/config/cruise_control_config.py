@@ -149,12 +149,27 @@ def _webserver_def() -> ConfigDef:
     d.define("webserver.auth.trusted.proxy.ips", ConfigType.STRING, "")
     d.define("webserver.auth.trusted.proxy.user.header", ConfigType.STRING,
              "X-Forwarded-User")
+    # SPNEGO (reference servlet/security/spnego/*): the GSS ticket validator
+    # is a plugin — Kerberos libraries are deployment-specific.
+    d.define("webserver.auth.spnego.validator.class", ConfigType.CLASS, None,
+             doc="callable/class returning the authenticated principal for a "
+                 "GSS token; replaces the reference's JAAS+keytab wiring "
+                 "(spnego.keytab.file / spnego.principal)")
     # TLS listener (reference WebServerConfig WEBSERVER_SSL_* +
-    # KafkaCruiseControlApp.java:100-120): PEM certificate chain + key.
+    # KafkaCruiseControlApp.java:100-120).  INTENTIONAL DEVIATION: the
+    # reference configures a JKS/PKCS12 keystore (webserver.ssl.keystore.
+    # location/.password/.type, webserver.ssl.key.password); Python's ssl
+    # module loads PEM, so the keys here name a PEM chain + key instead.
+    # main.py points reference-keystore users at the rename.
     d.define("webserver.ssl.enable", ConfigType.BOOLEAN, False)
-    d.define("webserver.ssl.certfile", ConfigType.STRING, "")
-    d.define("webserver.ssl.keyfile", ConfigType.STRING, "")
-    d.define("webserver.ssl.keyfile.password", ConfigType.STRING, "")
+    d.define("webserver.ssl.certfile", ConfigType.STRING, "",
+             doc="PEM cert chain; replaces the reference's "
+                 "`webserver.ssl.keystore.location` (JKS/PKCS12 keystores "
+                 "are JVM-specific — export to PEM)")
+    d.define("webserver.ssl.keyfile", ConfigType.STRING, "",
+             doc="PEM private key (reference: inside the keystore)")
+    d.define("webserver.ssl.keyfile.password", ConfigType.STRING, "",
+             doc="replaces the reference's `webserver.ssl.key.password`")
     d.define("max.active.user.tasks", ConfigType.INT, 25)
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG, 86_400_000)
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False)

@@ -11,10 +11,13 @@ asserts the user via header from an allow-listed address).
 
 Everything is stdlib: the server is control-plane and must stay hermetic.
 
-Intentionally absent: the reference's SPNEGO/Kerberos provider
-(``servlet/security/spnego/*``) — it requires a KDC and the JAAS/GSSAPI
-stack; deployments fronting this service with Kerberos should use the
-TrustedProxy provider behind an authenticating proxy instead.
+SPNEGO (``servlet/security/spnego/SpnegoSecurityProvider.java`` +
+``SpnegoUserStoreAuthorizationService.java``) is implemented as Negotiate
+header parsing over a PLUGGABLE ticket validator: the GSSAPI exchange itself
+belongs to a Kerberos library this control plane does not vendor, so the
+validator is injected (``webserver.auth.spnego.validator.class``) and the
+role lookup reuses the same realm-properties user store the reference's
+``UserStoreAuthorizationService`` reads.
 """
 
 from __future__ import annotations
@@ -24,6 +27,7 @@ import binascii
 import hashlib
 import hmac
 import json
+import threading
 import time
 from dataclasses import dataclass
 from enum import Enum
@@ -235,3 +239,81 @@ class TrustedProxySecurityProvider:
 
     def challenge(self) -> Dict[str, str]:
         return {}
+
+
+# --------------------------------------------------------------------- SPNEGO
+
+
+class SpnegoSecurityProvider:
+    """Kerberos Negotiate auth (SpnegoSecurityProvider.java:36-70 +
+    SpnegoUserStoreAuthorizationService.java).
+
+    The HTTP side — ``Authorization: Negotiate <base64 GSS token>`` parsing,
+    the 401 challenge, principal short-naming (``user/host@REALM`` → user,
+    KerberosShortNamer's DEFAULT_TO_LOCAL rule), and user-store role lookup —
+    is all here.  The cryptographic ticket validation is delegated to
+    ``ticket_validator(token_bytes)``, which returns the authenticated
+    principal name (optionally ``(principal, mutual_auth_token_bytes)``) or
+    None/raises on a bad ticket.  Deployments supply a GSSAPI-backed
+    validator; tests a fake.
+    """
+
+    def __init__(self, ticket_validator,
+                 roles_by_user: Optional[Dict[str, Role]] = None,
+                 credentials_file: Optional[str] = None,
+                 default_role: Optional[Role] = Role.USER):
+        self.ticket_validator = ticket_validator
+        self.roles_by_user = dict(roles_by_user or {})
+        if credentials_file:
+            for name, (_pw, role) in parse_credentials_file(credentials_file).items():
+                self.roles_by_user[name] = role
+        # None = users absent from the store are rejected (the reference's
+        # user-store authorization returns no roles → 403).
+        self.default_role = default_role
+        # Per-THREAD: one provider instance serves every request of a
+        # ThreadingHTTPServer concurrently; a shared slot would hand one
+        # request's GSS mutual-auth material to another's response.
+        self._tls = threading.local()
+
+    @staticmethod
+    def short_name(principal: str) -> str:
+        """``alice/admin.example.com@EXAMPLE.COM`` → ``alice``."""
+        return principal.split("@", 1)[0].split("/", 1)[0]
+
+    def authenticate(self, headers: Dict[str, str],
+                     client_ip: str) -> Optional[Principal]:
+        self._tls.mutual_token = None   # cleared on EVERY path, success or not
+        auth = header_get(headers, "Authorization") or ""
+        if not auth.startswith("Negotiate "):
+            return None
+        try:
+            token = base64.b64decode(auth[len("Negotiate "):], validate=True)
+        except binascii.Error:
+            return None
+        try:
+            result = self.ticket_validator(token)
+        except Exception:
+            return None
+        if isinstance(result, tuple):
+            result, self._tls.mutual_token = result
+        if not result:
+            return None
+        name = self.short_name(str(result))
+        role = self.roles_by_user.get(name, self.default_role)
+        if role is None:
+            return None
+        return Principal(name=name, role=role)
+
+    def challenge(self) -> Dict[str, str]:
+        # RFC 4559: bare challenge on 401; mutual-auth token after success is
+        # attached by the caller via mutual_auth_header().
+        return {"WWW-Authenticate": "Negotiate"}
+
+    def mutual_auth_header(self) -> Dict[str, str]:
+        """Success-response headers for the CURRENT thread's exchange; the
+        servlet merges these into the 2xx reply (RFC 4559 §4.2)."""
+        token = getattr(self._tls, "mutual_token", None)
+        if token is None:
+            return {}
+        return {"WWW-Authenticate":
+                "Negotiate " + base64.b64encode(token).decode()}

@@ -480,6 +480,11 @@ def _make_handler(app: CruiseControlApp):
                     "error": type(e).__name__, "message": str(e)}, {}
             if isinstance(payload, dict):
                 payload.setdefault("version", 1)
+            # SPNEGO mutual auth: the provider may carry a GSS reply token
+            # for this thread's successful exchange (RFC 4559 §4.2).
+            mutual = getattr(app.security, "mutual_auth_header", None)
+            if mutual is not None:
+                headers = {**(headers or {}), **mutual()}
             self._send(status, payload, headers)
 
         def _send(self, status: int, payload: Dict,

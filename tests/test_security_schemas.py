@@ -82,6 +82,93 @@ def test_trusted_proxy_provider():
     assert p.authenticate({}, "10.0.0.1") is None
 
 
+def test_spnego_provider(tmp_path):
+    """SpnegoSecurityProvider.java:36-70 semantics with a fake GSS validator:
+    Negotiate header parsing, principal short-naming, user-store role lookup,
+    mutual-auth token passthrough, bad-ticket → None."""
+    from cruise_control_tpu.servlet.security import SpnegoSecurityProvider
+
+    store = tmp_path / "realm.properties"
+    store.write_text("alice: x, ADMIN\nbob: x, VIEWER\n")
+
+    def validator(token: bytes):
+        if token == b"good-alice":
+            return "alice/host.example.com@EXAMPLE.COM", b"mutual-tok"
+        if token == b"good-bob":
+            return "bob@EXAMPLE.COM"
+        raise ValueError("bad ticket")
+
+    p = SpnegoSecurityProvider(validator, credentials_file=str(store),
+                               default_role=None)
+
+    def hdr(tok: bytes):
+        return {"Authorization": "Negotiate " + base64.b64encode(tok).decode()}
+
+    assert p.authenticate(hdr(b"good-alice"), "1.2.3.4") == \
+        Principal("alice", Role.ADMIN)
+    assert p.mutual_auth_header() == {
+        "WWW-Authenticate": "Negotiate " + base64.b64encode(b"mutual-tok").decode()}
+    assert p.authenticate(hdr(b"good-bob"), "1.2.3.4") == \
+        Principal("bob", Role.VIEWER)
+    assert p.mutual_auth_header() == {}          # no mutual token this time
+    assert p.authenticate(hdr(b"forged"), "1.2.3.4") is None
+    assert p.authenticate({}, "1.2.3.4") is None
+    assert p.authenticate({"Authorization": "Negotiate !!!"}, "1.2.3.4") is None
+    assert p.challenge() == {"WWW-Authenticate": "Negotiate"}
+
+    # Unknown-but-authenticated principals: rejected without a default role,
+    # admitted with one (UserStoreAuthorizationService returns no roles → 403).
+    def v2(token):
+        return "mallory@EXAMPLE.COM"
+    assert SpnegoSecurityProvider(v2, default_role=None).authenticate(
+        hdr(b"t"), "") is None
+    assert SpnegoSecurityProvider(v2).authenticate(
+        hdr(b"t"), "") == Principal("mallory", Role.USER)
+
+
+def test_spnego_provider_from_config(tmp_path):
+    """main._security_provider must RESOLVE validator.class (a dotted path
+    string after config parsing) via get_configured_instance, not hand the
+    raw string to the provider."""
+    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+    from cruise_control_tpu.main import _security_provider
+    from cruise_control_tpu.servlet.security import SpnegoSecurityProvider
+
+    store = tmp_path / "realm.properties"
+    store.write_text("carol: x, ADMIN\n")
+    cfg = CruiseControlConfig({
+        "webserver.security.enable": "true",
+        "webserver.security.provider": "spnego",
+        "webserver.auth.credentials.file": str(store),
+        "webserver.auth.spnego.validator.class":
+            "cruise_control_tpu.testing.fake_gss.FakeGssValidator",
+    })
+    provider = _security_provider(cfg)
+    assert isinstance(provider, SpnegoSecurityProvider)
+
+    def hdr(principal: bytes):
+        return {"Authorization":
+                "Negotiate " + base64.b64encode(b"principal:" + principal).decode()}
+
+    assert provider.authenticate(hdr(b"carol"), "1.2.3.4") == \
+        Principal("carol", Role.ADMIN)
+    # Authenticated-but-unknown principals are REJECTED (user-store
+    # authorization, not a default role — the reference 403s them).
+    assert provider.authenticate(hdr(b"mallory"), "1.2.3.4") is None
+
+    with pytest.raises(ValueError, match="validator.class required"):
+        _security_provider(CruiseControlConfig({
+            "webserver.security.enable": "true",
+            "webserver.security.provider": "spnego",
+            "webserver.auth.credentials.file": str(store)}))
+    with pytest.raises(ValueError, match="credentials.file required"):
+        _security_provider(CruiseControlConfig({
+            "webserver.security.enable": "true",
+            "webserver.security.provider": "spnego",
+            "webserver.auth.spnego.validator.class":
+                "cruise_control_tpu.testing.fake_gss.FakeGssValidator"}))
+
+
 def test_schema_checker():
     schema = {"type": "object", "required": ["a"],
               "properties": {"a": {"type": "integer"},
