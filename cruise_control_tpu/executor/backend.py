@@ -32,7 +32,15 @@ class ClusterAdminBackend(Protocol):
     def finished(self, task: ExecutionTask) -> bool: ...
 
     def set_throttles(self, rate_bytes_per_s: Optional[int],
-                      partitions: Sequence[TP]) -> None: ...
+                      partitions: Sequence[TP],
+                      brokers: Sequence[int] = (),
+                      proposals: Sequence = ()) -> None:
+        """``brokers`` = every broker involved in the movements (old ∪ new
+        replicas) and ``proposals`` the ExecutionProposals themselves —
+        ReplicationThrottleHelper derives everything from the proposals:
+        destinations that hold nothing yet still get rate configs, and the
+        ADDING replicas go into the follower throttled-replicas lists."""
+        ...
 
     def clear_throttles(self) -> None: ...
 
@@ -48,6 +56,7 @@ class FakeClusterBackend:
         self._tasks: Dict[int, ExecutionTask] = {}
         self.throttle_rate: Optional[int] = None
         self.throttled_partitions: List[TP] = []
+        self.throttled_brokers: List[int] = []
         self.reassignment_log: List[TP] = []
 
     # ------------------------------------------------------------- execute
@@ -101,9 +110,11 @@ class FakeClusterBackend:
 
     # ----------------------------------------------------------- throttles
 
-    def set_throttles(self, rate_bytes_per_s, partitions) -> None:
+    def set_throttles(self, rate_bytes_per_s, partitions, brokers=(),
+                      proposals=()) -> None:
         self.throttle_rate = rate_bytes_per_s
         self.throttled_partitions = list(partitions)
+        self.throttled_brokers = list(brokers)
 
     def clear_throttles(self) -> None:
         self.throttle_rate = None

@@ -1,0 +1,289 @@
+"""Scripted broker simulator — the out-of-process stand-in cluster.
+
+The analog of the reference's embedded-broker integration harness
+(``cruise-control-metrics-reporter/src/test/.../CCKafkaIntegrationTestHarness
+.java``): a separate PROCESS that speaks an admin protocol, so the executor's
+cluster driver (``subprocess_backend.SubprocessClusterBackend``) is exercised
+over a real process boundary — serialization, request/response framing, dead
+-peer behavior — not an in-process object graph.
+
+Protocol: one JSON object per line on stdin, one JSON reply per line on
+stdout (``{"id": n, "op": ...}`` → ``{"id": n, "ok": true, ...}``).  The op
+surface mirrors the slices of the Kafka admin API the reference's executor
+drives: partition reassignments (``ExecutorUtils.scala:31-93``), logdir moves
+(``ExecutorAdminUtils.java:33-124``), preferred-leader election
+(``ExecutorUtils.scala:94-114``), and incremental config changes for
+replication throttles (``ReplicationThrottleHelper.java:29-321`` — the same
+``*.replication.throttled.rate``/``.replicas`` keys).
+
+Replication progress is poll-driven and deterministic: each ``is_done`` query
+for a movement decrements its countdown (``polls_to_finish`` ticks), and
+movements touching a failed broker never progress — which is how tests
+exercise the executor's dead-task timeout path.
+
+Run standalone: ``python -m cruise_control_tpu.executor.broker_simulator``.
+No jax anywhere on this import path — the process must start in
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+TP = Tuple[str, int]
+
+# ReplicationThrottleHelper.java:38-45 — the exact dynamic-config keys.
+LEADER_THROTTLED_RATE = "leader.replication.throttled.rate"
+FOLLOWER_THROTTLED_RATE = "follower.replication.throttled.rate"
+LEADER_THROTTLED_REPLICAS = "leader.replication.throttled.replicas"
+FOLLOWER_THROTTLED_REPLICAS = "follower.replication.throttled.replicas"
+
+
+class BrokerSimulator:
+    """In-memory cluster state + admin op handlers (usable in-process by unit
+    tests; the __main__ loop wraps it in stdio framing)."""
+
+    def __init__(self, polls_to_finish: int = 2):
+        self.polls_to_finish = polls_to_finish
+        # (topic, partition) -> {"replicas": [b...], "leader": b,
+        #                        "logdirs": {b: dir}}
+        self.partitions: Dict[TP, Dict] = {}
+        # In-flight movements: key -> {"ticks": n, "apply": {...}}
+        self._reassign: Dict[TP, Dict] = {}
+        self._logdir: Dict[Tuple[str, int, int], Dict] = {}
+        self._election: Dict[TP, Dict] = {}
+        self.failed_brokers: set = set()
+        self.broker_configs: Dict[int, Dict[str, str]] = {}
+        self.topic_configs: Dict[str, Dict[str, str]] = {}
+        # Audit trail for test assertions.
+        self.config_log: List[Dict] = []
+        self.max_inflight = 0
+        self.max_inflight_per_broker: Dict[int, int] = {}
+
+    # ------------------------------------------------------------- handlers
+
+    def handle(self, req: Dict) -> Dict:
+        op = req.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        try:
+            out = fn(req) or {}
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        out.setdefault("ok", True)
+        return out
+
+    def op_bootstrap(self, req):
+        for p in req["partitions"]:
+            key = (p["topic"], int(p["partition"]))
+            self.partitions[key] = {
+                "replicas": [int(b) for b in p["replicas"]],
+                "leader": int(p.get("leader", p["replicas"][0])),
+                "logdirs": {int(b): int(d) for b, d in
+                            (p.get("logdirs") or {}).items()},
+            }
+
+    def op_describe_topics(self, req):
+        return {"partitions": [
+            {"topic": t, "partition": p, "replicas": st["replicas"],
+             "leader": st["leader"],
+             "logdirs": {str(b): d for b, d in st["logdirs"].items()}}
+            for (t, p), st in sorted(self.partitions.items())]}
+
+    # -- movements
+
+    def _track_inflight(self) -> None:
+        per_broker: Dict[int, int] = {}
+        for key, mv in self._reassign.items():
+            for b in mv["brokers"]:
+                per_broker[b] = per_broker.get(b, 0) + 1
+        self.max_inflight = max(self.max_inflight,
+                                len(self._reassign) + len(self._logdir))
+        for b, n in per_broker.items():
+            self.max_inflight_per_broker[b] = max(
+                self.max_inflight_per_broker.get(b, 0), n)
+
+    def op_alter_partition_reassignments(self, req):
+        for r in req["reassignments"]:
+            key = (r["topic"], int(r["partition"]))
+            if key not in self.partitions:
+                raise KeyError(f"unknown partition {key}")
+            target = [int(b) for b in r["replicas"]]
+            cur = self.partitions[key]
+            stuck = bool(self.failed_brokers.intersection(
+                set(target) | set(cur["replicas"])))
+            self._reassign[key] = {
+                "ticks": -1 if stuck else self.polls_to_finish,
+                "target": target,
+                "logdirs": {int(b): int(d) for b, d in
+                            (r.get("logdirs") or {}).items()},
+                "brokers": sorted(set(target) | set(cur["replicas"])),
+            }
+        self._track_inflight()
+
+    def op_alter_replica_log_dirs(self, req):
+        for r in req["moves"]:
+            key = (r["topic"], int(r["partition"]), int(r["broker"]))
+            tp = key[:2]
+            if tp not in self.partitions:
+                raise KeyError(f"unknown partition {tp}")
+            stuck = key[2] in self.failed_brokers
+            self._logdir[key] = {
+                "ticks": -1 if stuck else self.polls_to_finish,
+                "target": int(r["logdir"]),
+            }
+        self._track_inflight()
+
+    def op_elect_leaders(self, req):
+        for r in req["partitions"]:
+            key = (r["topic"], int(r["partition"]))
+            if key not in self.partitions:
+                raise KeyError(f"unknown partition {key}")
+            # Preferred = explicit target when given, else first alive
+            # replica in assignment order (ExecutorUtils.scala:94-114).
+            self._election[key] = {"ticks": 1,
+                                   "leader": r.get("leader")}
+
+    def op_list_partition_reassignments(self, req):
+        return {"reassignments": [
+            {"topic": t, "partition": p} for t, p in sorted(self._reassign)]}
+
+    def op_is_done(self, req):
+        kind = req.get("kind", "reassign")
+        key = (req["topic"], int(req["partition"]))
+        if kind == "reassign":
+            return {"done": self._advance(self._reassign, key,
+                                          self._apply_reassign)}
+        if kind == "logdir":
+            k3 = (*key, int(req["broker"]))
+            return {"done": self._advance(self._logdir, k3,
+                                          self._apply_logdir)}
+        if kind == "leader":
+            return {"done": self._advance(self._election, key,
+                                          self._apply_election)}
+        raise ValueError(f"unknown kind {kind!r}")
+
+    def _advance(self, table, key, apply_fn) -> bool:
+        mv = table.get(key)
+        if mv is None:
+            return True
+        if mv["ticks"] < 0:          # stuck on a failed broker
+            return False
+        mv["ticks"] -= 1
+        if mv["ticks"] > 0:
+            return False
+        apply_fn(key, mv)
+        del table[key]
+        return True
+
+    def _apply_reassign(self, key: TP, mv) -> None:
+        st = self.partitions[key]
+        st["replicas"] = list(mv["target"])
+        for b, d in mv["logdirs"].items():
+            st["logdirs"][b] = d
+        for b in list(st["logdirs"]):
+            if b not in mv["target"]:
+                del st["logdirs"][b]
+        # Kafka keeps the current leader unless it was removed.
+        if st["leader"] not in st["replicas"]:
+            st["leader"] = st["replicas"][0]
+
+    def _apply_logdir(self, key, mv) -> None:
+        t, p, b = key
+        self.partitions[(t, p)]["logdirs"][b] = mv["target"]
+
+    def _apply_election(self, key: TP, mv) -> None:
+        st = self.partitions[key]
+        want = mv.get("leader")
+        if want is not None and int(want) in st["replicas"] \
+                and int(want) not in self.failed_brokers:
+            st["leader"] = int(want)
+            return
+        for b in st["replicas"]:
+            if b not in self.failed_brokers:
+                st["leader"] = b
+                break
+
+    # -- configs (throttles)
+
+    def op_incremental_alter_configs(self, req):
+        entity_type = req["entity_type"]
+        entity = req["entity"]
+        table = (self.broker_configs.setdefault(int(entity), {})
+                 if entity_type == "broker"
+                 else self.topic_configs.setdefault(str(entity), {}))
+        for c in req["ops"]:
+            if c.get("op", "set") == "delete":
+                table.pop(c["name"], None)
+            else:
+                table[c["name"]] = str(c["value"])
+            self.config_log.append({"entity_type": entity_type,
+                                    "entity": entity, **c})
+
+    def op_describe_configs(self, req):
+        """Single entity (``entity``) or batched (``entities`` list — the
+        Kafka AdminClient describeConfigs shape, one round trip for many)."""
+        def lookup(entity):
+            if req["entity_type"] == "broker":
+                return dict(self.broker_configs.get(int(entity), {}))
+            return dict(self.topic_configs.get(str(entity), {}))
+
+        if "entities" in req:
+            return {"configs_by_entity": {str(e): lookup(e)
+                                          for e in req["entities"]}}
+        return {"configs": lookup(req["entity"])}
+
+    # -- fault injection / introspection (test-only surface)
+
+    def op_fail_broker(self, req):
+        self.failed_brokers.add(int(req["broker"]))
+        for mv in self._reassign.values():
+            if self.failed_brokers.intersection(mv["brokers"]):
+                mv["ticks"] = -1
+
+    def op_restore_broker(self, req):
+        self.failed_brokers.discard(int(req["broker"]))
+
+    def op_stats(self, req):
+        return {"max_inflight": self.max_inflight,
+                "max_inflight_per_broker": {
+                    str(b): n for b, n in self.max_inflight_per_broker.items()},
+                "config_log": self.config_log}
+
+    def op_ping(self, req):
+        return {}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    polls = 2
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--polls-to-finish" in args:
+        polls = int(args[args.index("--polls-to-finish") + 1])
+    sim = BrokerSimulator(polls_to_finish=polls)
+    out = sys.stdout
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError as e:
+            out.write(json.dumps({"ok": False, "error": f"bad json: {e}"}) + "\n")
+            out.flush()
+            continue
+        if req.get("op") == "shutdown":
+            out.write(json.dumps({"id": req.get("id"), "ok": True}) + "\n")
+            out.flush()
+            return 0
+        resp = sim.handle(req)
+        resp["id"] = req.get("id")
+        out.write(json.dumps(resp) + "\n")
+        out.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

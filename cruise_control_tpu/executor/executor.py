@@ -243,12 +243,30 @@ class Executor:
         try:
             if self._pause_sampling:
                 self._pause_sampling()
+            inter = self._planner.remaining_inter_broker_tasks
             throttled = [
                 (t.proposal.topic_partition.topic, t.proposal.topic_partition.partition)
-                for t in self._planner.remaining_inter_broker_tasks]
+                for t in inter]
             if self.config.replication_throttle_bytes_per_s and throttled:
-                self.backend.set_throttles(
-                    self.config.replication_throttle_bytes_per_s, throttled)
+                throttled_brokers = sorted(
+                    {b for t in inter for b in t.brokers_involved})
+                try:
+                    self.backend.set_throttles(
+                        self.config.replication_throttle_bytes_per_s, throttled,
+                        throttled_brokers,
+                        proposals=[t.proposal for t in inter])
+                except Exception:  # noqa: BLE001 — same dead-peer policy as
+                    # the movement submits: abort the execution with the
+                    # planned tasks marked DEAD, not a dead thread with every
+                    # task stuck PENDING.
+                    LOG.exception("throttle setup failed; aborting execution")
+                    for t in self._planner.clear():
+                        if t.state is ExecutionTaskState.PENDING:
+                            self.tracker.transition(
+                                t, ExecutionTaskState.IN_PROGRESS, self._now_ms())
+                            self.tracker.transition(
+                                t, ExecutionTaskState.DEAD, self._now_ms())
+                    return
             self._set_state(
                 ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
             self._move_replicas(TaskType.INTER_BROKER_REPLICA_ACTION,
@@ -272,7 +290,10 @@ class Executor:
                         self.tracker.transition(t, ExecutionTaskState.DEAD,
                                                 self._now_ms())
             if self.config.replication_throttle_bytes_per_s:
-                self.backend.clear_throttles()
+                try:
+                    self.backend.clear_throttles()
+                except Exception:  # noqa: BLE001 — the finally must finish
+                    LOG.exception("failed to clear replication throttles")
             if self._resume_sampling:
                 self._resume_sampling()
             with self._lock:
@@ -315,7 +336,23 @@ class Executor:
             ready = {b: cap for t in self._all_brokers(task_type) for b in [t]}
             batch = batch_fn(ready, in_flight)
             if batch:
-                submit_fn(batch)
+                try:
+                    submit_fn(batch)
+                except Exception:  # noqa: BLE001 — backend/peer failure
+                    # Submission failed (admin peer dead, protocol error):
+                    # the batch is DEAD, not stuck — mirrors the reference's
+                    # task-dead handling (Executor.java:1457-1540) instead of
+                    # killing the progress thread.
+                    LOG.exception("movement submission failed; marking %d "
+                                  "tasks dead", len(batch))
+                    for t in batch:
+                        self.tracker.transition(
+                            t, ExecutionTaskState.IN_PROGRESS, self._now_ms())
+                        self.tracker.transition(
+                            t, ExecutionTaskState.DEAD, self._now_ms())
+                    if self.config.auto_adjust_concurrency:
+                        self.adjuster.on_distress()
+                    continue
                 for t in batch:
                     self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
                                             self._now_ms())
@@ -373,14 +410,38 @@ class Executor:
                 self.config.concurrent_leader_movements)
             if not batch:
                 break
-            self.backend.execute_preferred_leader_election(batch)
+            try:
+                self.backend.execute_preferred_leader_election(batch)
+            except Exception:  # noqa: BLE001 — same dead-peer handling as moves
+                LOG.exception("leadership submission failed; marking %d "
+                              "tasks dead", len(batch))
+                for t in batch:
+                    self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
+                                            self._now_ms())
+                    self.tracker.transition(t, ExecutionTaskState.DEAD,
+                                            self._now_ms())
+                continue
             for t in batch:
                 self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
                                         self._now_ms())
             pending = list(batch)
             while pending and not self._stop_requested.is_set():
                 time.sleep(self.config.progress_check_interval_s)
-                pending = [t for t in pending if not self._maybe_complete(t)]
+                still = []
+                for t in pending:
+                    if self._maybe_complete(t):
+                        continue
+                    # Same dead-task timeout as the replica loops: a peer
+                    # that dies after a successful election submit reads as
+                    # finished()=False forever, and without this branch the
+                    # executor would stay in LEADER_MOVEMENT for good.
+                    if (self._now_ms() - t.start_time_ms
+                            > self.config.task_execution_alert_timeout_s * 1000):
+                        self.tracker.transition(t, ExecutionTaskState.DEAD,
+                                                self._now_ms())
+                    else:
+                        still.append(t)
+                pending = still
 
     def _maybe_complete(self, t: ExecutionTask) -> bool:
         if self.backend.finished(t):

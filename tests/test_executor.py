@@ -180,12 +180,14 @@ def test_throttles_set_and_cleared():
     seen = {}
     orig = backend.set_throttles
 
-    def spy(rate, partitions):
+    def spy(rate, partitions, brokers=(), proposals=()):
         seen["rate"] = rate
         seen["partitions"] = list(partitions)
-        orig(rate, partitions)
+        seen["brokers"] = list(brokers)
+        orig(rate, partitions, brokers, proposals)
 
     backend.set_throttles = spy
     ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
     assert seen["rate"] == 1_000_000
+    assert seen["brokers"] == [0, 1, 2]       # old ∪ new replicas
     assert backend.throttle_rate is None      # cleared after execution

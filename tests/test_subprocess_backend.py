@@ -1,0 +1,306 @@
+"""Out-of-process executor driver tests.
+
+The integration analog of the reference's embedded-broker ``ExecutorTest``
+(``CCKafkaIntegrationTestHarness`` + real AdminClient): a full rebalance runs
+executor → SubprocessClusterBackend → broker_simulator PROCESS, verifying
+movement application, batching caps, throttle set/clear
+(ReplicationThrottleHelper.java:29-321 key names), and dead-task handling
+when a broker never completes its movement.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cruise_control_tpu.common.actions import (
+    ExecutionProposal,
+    ReplicaPlacementInfo,
+    TopicPartition,
+)
+from cruise_control_tpu.executor.broker_simulator import (
+    BrokerSimulator,
+    FOLLOWER_THROTTLED_RATE,
+    FOLLOWER_THROTTLED_REPLICAS,
+    LEADER_THROTTLED_RATE,
+    LEADER_THROTTLED_REPLICAS,
+)
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.subprocess_backend import (
+    BackendTransportError,
+    SubprocessClusterBackend,
+)
+from cruise_control_tpu.executor.tasks import ExecutionTaskState, TaskType
+
+
+def proposal(topic, part, old, new, size=100.0, old_dirs=None, new_dirs=None):
+    old_dirs = old_dirs or [None] * len(old)
+    new_dirs = new_dirs or [None] * len(new)
+    return ExecutionProposal(
+        topic_partition=TopicPartition(topic, part),
+        partition_size=size,
+        old_leader=ReplicaPlacementInfo(old[0], old_dirs[0]),
+        old_replicas=tuple(ReplicaPlacementInfo(b, d)
+                           for b, d in zip(old, old_dirs)),
+        new_replicas=tuple(ReplicaPlacementInfo(b, d)
+                           for b, d in zip(new, new_dirs)),
+    )
+
+
+def bootstrap_partitions():
+    """4 brokers; T-0..T-3 on (p%4, (p+1)%4)."""
+    return [{"topic": "T", "partition": p,
+             "replicas": [p % 4, (p + 1) % 4], "leader": p % 4}
+            for p in range(4)]
+
+
+@pytest.fixture
+def backend():
+    b = SubprocessClusterBackend.spawn(bootstrap_partitions(),
+                                       polls_to_finish=2)
+    yield b
+    b.close()
+
+
+def test_simulator_unit_roundtrip():
+    """The simulator itself, in-process: movement lifecycle + config ops."""
+    sim = BrokerSimulator(polls_to_finish=2)
+    sim.handle({"op": "bootstrap", "partitions": bootstrap_partitions()})
+    sim.handle({"op": "alter_partition_reassignments",
+                "reassignments": [{"topic": "T", "partition": 0,
+                                   "replicas": [2, 1]}]})
+    assert sim.handle({"op": "list_partition_reassignments"})[
+        "reassignments"] == [{"topic": "T", "partition": 0}]
+    assert sim.handle({"op": "is_done", "topic": "T", "partition": 0})["done"] is False
+    assert sim.handle({"op": "is_done", "topic": "T", "partition": 0})["done"] is True
+    state = sim.partitions[("T", 0)]
+    assert state["replicas"] == [2, 1]
+    # Old leader (0) was removed → first new replica leads.
+    assert state["leader"] == 2
+    # Unknown partition errors instead of inventing state.
+    resp = sim.handle({"op": "alter_partition_reassignments",
+                       "reassignments": [{"topic": "X", "partition": 9,
+                                          "replicas": [0]}]})
+    assert not resp["ok"] and "unknown partition" in resp["error"]
+
+
+def test_full_rebalance_through_subprocess(backend):
+    """Executor drives replica moves + leadership through the child process;
+    final assignments in the CHILD match the proposals."""
+    proposals = [
+        proposal("T", 0, [0, 1], [2, 1]),        # replica move 0 -> 2
+        proposal("T", 1, [1, 2], [3, 2]),        # replica move 1 -> 3
+        proposal("T", 2, [2, 3], [3, 2]),        # pure leadership 2 -> 3
+    ]
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+    ex.execute_proposals(proposals, wait=True)
+
+    final = {(d["topic"], d["partition"]): d for d in backend.describe_topics()}
+    assert final[("T", 0)]["replicas"] == [2, 1]
+    assert final[("T", 1)]["replicas"] == [3, 2]
+    assert final[("T", 2)]["leader"] == 3
+    done = ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.COMPLETED)
+    assert done >= 2
+
+
+def test_throttles_set_and_cleared_through_subprocess(backend):
+    """Rate configs appear on involved brokers and replica lists on involved
+    topics during execution, with the reference's exact key names, and are
+    removed afterwards — while operator-set values on INVOLVED entities are
+    preserved (rates not overwritten, replica lists merged then restored),
+    per ReplicationThrottleHelper's merge/restore semantics."""
+    backend.request("incremental_alter_configs", entity_type="broker",
+                    entity=3, ops=[{"name": LEADER_THROTTLED_RATE,
+                                    "value": "12345"}])
+    # Operator throttles on INVOLVED entities: broker 0's leader rate and an
+    # operator entry in topic T's leader replica list.
+    backend.request("incremental_alter_configs", entity_type="broker",
+                    entity=0, ops=[{"name": LEADER_THROTTLED_RATE,
+                                    "value": "777"}])
+    backend.request("incremental_alter_configs", entity_type="topic",
+                    entity="T", ops=[{"name": LEADER_THROTTLED_REPLICAS,
+                                      "value": "0:9"}])
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01,
+                                          replication_throttle_bytes_per_s=1000))
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+
+    log = backend.stats()["config_log"]
+    # All values ever SET per key (cleanup restores are set ops too, so the
+    # merged execution-time value is asserted via membership, not last-wins).
+    set_values = {}
+    for e in log:
+        if e.get("op", "set") != "delete":
+            set_values.setdefault(
+                (e["entity_type"], str(e["entity"]), e["name"]),
+                []).append(e.get("value"))
+    set_entries = set_values
+    # Brokers 1,2 get both rates; broker 0's leader rate was operator-set so
+    # only its follower rate is added.
+    for b in ("1", "2"):
+        assert ("broker", b, LEADER_THROTTLED_RATE) in set_entries
+        assert ("broker", b, FOLLOWER_THROTTLED_RATE) in set_entries
+    assert ("broker", "0", FOLLOWER_THROTTLED_RATE) in set_entries
+    # Leader list = operator entry + OLD replicas (serve catch-up reads);
+    # follower list = the ADDING replica (issues the catch-up fetch).
+    assert "0:9,0:0,0:1" in \
+        set_entries[("topic", "T", LEADER_THROTTLED_REPLICAS)]
+    assert "0:2" in set_entries[("topic", "T", FOLLOWER_THROTTLED_REPLICAS)]
+
+    # Cleanup: our configs gone, operator values restored exactly.
+    for b in (1, 2):
+        cfg = backend.request("describe_configs", entity_type="broker",
+                              entity=b)["configs"]
+        assert LEADER_THROTTLED_RATE not in cfg, cfg
+    cfg0 = backend.request("describe_configs", entity_type="broker",
+                           entity=0)["configs"]
+    assert cfg0[LEADER_THROTTLED_RATE] == "777"
+    assert FOLLOWER_THROTTLED_RATE not in cfg0
+    cfg3 = backend.request("describe_configs", entity_type="broker",
+                           entity=3)["configs"]
+    assert cfg3[LEADER_THROTTLED_RATE] == "12345"
+    cfg_t = backend.request("describe_configs", entity_type="topic",
+                            entity="T")["configs"]
+    assert cfg_t.get(LEADER_THROTTLED_REPLICAS) == "0:9"
+    assert FOLLOWER_THROTTLED_REPLICAS not in cfg_t
+
+
+def test_batching_respects_movement_cap(backend):
+    """Per-broker concurrency 1: the child must never see more than one
+    in-flight movement per broker."""
+    proposals = [proposal("T", p, [p % 4, (p + 1) % 4],
+                          [(p + 2) % 4, (p + 1) % 4]) for p in range(4)]
+    ex = Executor(backend, ExecutorConfig(
+        progress_check_interval_s=0.01,
+        concurrent_partition_movements_per_broker=1))
+    ex.execute_proposals(proposals, wait=True)
+    per_broker = backend.stats()["max_inflight_per_broker"]
+    assert per_broker and all(n <= 1 for n in per_broker.values()), per_broker
+
+
+def test_logdir_moves_through_subprocess():
+    parts = [{"topic": "T", "partition": 0, "replicas": [0, 1], "leader": 0,
+              "logdirs": {"0": 0, "1": 0}}]
+    backend = SubprocessClusterBackend.spawn(parts, polls_to_finish=2)
+    try:
+        p = proposal("T", 0, [0, 1], [0, 1], old_dirs=[0, 0], new_dirs=[1, 0])
+        ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+        ex.execute_proposals([p], wait=True)
+        final = backend.describe_topics()[0]
+        assert final["logdirs"]["0"] == 1
+        assert ex.tracker.count(TaskType.INTRA_BROKER_REPLICA_ACTION,
+                                ExecutionTaskState.COMPLETED) == 1
+    finally:
+        backend.close()
+
+
+def test_dead_task_on_failed_broker(backend):
+    """A movement onto a failed broker never completes; the executor's
+    alert timeout marks it DEAD and the rest of the batch still lands."""
+    backend.request("fail_broker", broker=3)
+    proposals = [
+        proposal("T", 0, [0, 1], [2, 1]),        # healthy
+        proposal("T", 1, [1, 2], [3, 2]),        # 3 is down -> stuck
+    ]
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01,
+                                          task_execution_alert_timeout_s=0.3))
+    ex.execute_proposals(proposals, wait=True)
+    assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.COMPLETED) == 1
+    assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.DEAD) == 1
+    final = {(d["topic"], d["partition"]): d for d in backend.describe_topics()}
+    assert final[("T", 0)]["replicas"] == [2, 1]
+    assert final[("T", 1)]["replicas"] == [1, 2]   # unchanged
+
+
+def test_dead_peer_surfaces_as_timeout_then_dead_tasks():
+    """Killing the child mid-execution: submissions raise, progress polls
+    report unfinished, and the executor converges with DEAD tasks instead of
+    hanging."""
+    backend = SubprocessClusterBackend.spawn(bootstrap_partitions(),
+                                             polls_to_finish=50)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01,
+                                          task_execution_alert_timeout_s=0.3))
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=False)
+    backend.proc.kill()
+    ex._thread.join(timeout=10)
+    assert not ex._thread.is_alive()
+    assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.DEAD) == 1
+    with pytest.raises(BackendTransportError):
+        backend.request("ping")
+
+
+def test_throttle_setup_failure_aborts_with_dead_tasks(backend):
+    """A peer failure at throttle-setup time must abort the execution with
+    the planned tasks marked DEAD — not kill the thread with tasks stuck
+    PENDING.  (A peer dead BEFORE start is caller-visible instead: the
+    pre-start external-reassignment check raises, see below.)"""
+    def broken(rate, partitions, brokers=(), proposals=()):
+        raise BackendTransportError("peer write failed mid-setup")
+
+    backend.set_throttles = broken
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01,
+                                          replication_throttle_bytes_per_s=1000))
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+    assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.DEAD) == 1
+    assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                            ExecutionTaskState.PENDING) == 0
+    # Nothing moved in the child.
+    final = {(d["topic"], d["partition"]): d for d in backend.describe_topics()}
+    assert final[("T", 0)]["replicas"] == [0, 1]
+
+
+def test_dead_peer_before_start_is_caller_visible():
+    """execute_proposals' pre-start in-flight check runs on the CALLER
+    thread; a peer that is already gone surfaces there as an exception, with
+    no tasks enqueued (Executor.java caller-facing sanity failures)."""
+    backend = SubprocessClusterBackend.spawn(bootstrap_partitions())
+    backend.proc.kill()
+    backend.proc.wait(timeout=5)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+    with pytest.raises(BackendTransportError):
+        ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+    for state in ExecutionTaskState:
+        assert ex.tracker.count(TaskType.INTER_BROKER_REPLICA_ACTION,
+                                state) == 0
+
+
+def test_dead_peer_during_leadership_marks_dead():
+    """A peer that dies around a leadership election must not hang the
+    executor in LEADER_MOVEMENT forever: either the submit fails (dead-batch
+    path) or the progress polls never finish (alert-timeout path) — both
+    must converge to a DEAD task and a finished thread."""
+    backend = SubprocessClusterBackend.spawn(bootstrap_partitions(),
+                                             polls_to_finish=50)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01,
+                                          task_execution_alert_timeout_s=0.3))
+    ex.execute_proposals([proposal("T", 2, [2, 3], [3, 2])], wait=False)
+    backend.proc.kill()
+    ex._thread.join(timeout=15)
+    assert not ex._thread.is_alive()
+    assert ex.tracker.count(TaskType.LEADER_ACTION,
+                            ExecutionTaskState.DEAD) == 1
+
+
+def test_simulator_main_stdio_roundtrip():
+    """The __main__ stdio framing itself (bad json, shutdown rc=0)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cruise_control_tpu.executor.broker_simulator"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        proc.stdin.write("this is not json\n")
+        proc.stdin.write(json.dumps({"id": 1, "op": "ping"}) + "\n")
+        proc.stdin.write(json.dumps({"id": 2, "op": "shutdown"}) + "\n")
+        proc.stdin.flush()
+        lines = [json.loads(proc.stdout.readline()) for _ in range(3)]
+        assert lines[0]["ok"] is False
+        assert lines[1] == {"id": 1, "ok": True}
+        assert lines[2] == {"id": 2, "ok": True}
+        assert proc.wait(timeout=5) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
