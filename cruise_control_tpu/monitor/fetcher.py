@@ -12,7 +12,9 @@ parallel, and the combined raw batch feeds one vectorized processor pass.
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
+import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -49,14 +51,43 @@ class ConsumingMetricSampler:
     """
 
     def __init__(self, transport: Transport, num_fetchers: int = 4,
-                 processor: Optional[CruiseControlMetricsProcessor] = None):
+                 processor: Optional[CruiseControlMetricsProcessor] = None,
+                 offsets_path: Optional[str] = None):
         self.transport = transport
         self.num_fetchers = max(1, num_fetchers)
         self.processor = processor or CruiseControlMetricsProcessor()
+        # Committed consumer positions (the reference sampler's Kafka
+        # consumer-group offsets): without them a DURABLE transport would be
+        # re-ingested from offset 0 on every restart — a day of stale raw
+        # metrics folded into the current window and re-persisted by the
+        # sample store.  None = in-memory only (in-process transports).
+        self._offsets_path = offsets_path
         self._offsets: Dict[int, int] = {}
+        if offsets_path and os.path.exists(offsets_path):
+            try:
+                with open(offsets_path, encoding="utf-8") as f:
+                    self._offsets = {int(k): int(v)
+                                     for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                LOG.warning("unreadable consumer-offsets file %s; consuming "
+                            "from the log start", offsets_path, exc_info=True)
         self._lock = threading.Lock()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self.num_fetchers, thread_name_prefix="metric-fetcher")
+
+    def _commit_offsets(self) -> None:
+        if not self._offsets_path:
+            return
+        with self._lock:
+            snapshot = dict(self._offsets)
+        tmp = self._offsets_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, self._offsets_path)
+        except OSError:
+            LOG.warning("failed to commit consumer offsets to %s",
+                        self._offsets_path, exc_info=True)
 
     def _fetch_partitions(self, partitions: Sequence[int],
                           start_ms: float, end_ms: float) -> List[CruiseControlMetric]:
@@ -83,6 +114,8 @@ class ConsumingMetricSampler:
         return out
 
     def get_samples(self, metadata, start_ms: float, end_ms: float) -> SamplerResult:
+        with self._lock:
+            pre_fetch = dict(self._offsets)
         assignment = DefaultMetricSamplerPartitionAssignor.assign(
             self.transport.num_partitions, self.num_fetchers)
         futures = [self._pool.submit(self._fetch_partitions, parts, start_ms, end_ms)
@@ -91,8 +124,22 @@ class ConsumingMetricSampler:
         for f in concurrent.futures.as_completed(futures):
             raw.extend(f.result())
         if not raw:
+            self._commit_offsets()
             return SamplerResult()
-        return self.processor.process(metadata, raw, end_ms)
+        try:
+            result = self.processor.process(metadata, raw, end_ms)
+        except Exception:
+            # At-least-once: roll the IN-MEMORY positions back too — with
+            # only the durable file kept, the next tick in this process
+            # would fetch from the advanced positions and then commit them,
+            # silently dropping the failed interval.
+            with self._lock:
+                self._offsets = pre_fetch
+            raise
+        # Commit AFTER successful processing (the Kafka consumer pattern the
+        # reference relies on).
+        self._commit_offsets()
+        return result
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

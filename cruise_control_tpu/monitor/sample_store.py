@@ -2,10 +2,11 @@
 
 Reference: ``monitor/sampling/SampleStore.java:19`` SPI and
 ``KafkaSampleStore.java:82-504`` — the reference persists accepted samples to
-two Kafka topics and replays them on startup.  Here the durable medium is a
-pluggable store; the built-in implementation appends JSONL segment files per
-sample type and replays them through the same loader interface
-(``SampleLoadingTask`` semantics).
+two Kafka topics and replays them on startup.  Two built-in implementations:
+``FileSampleStore`` (flat JSONL per sample type, bounded retention) and
+``LogSampleStore`` (the KafkaSampleStore shape — two partitioned-log
+``Transport`` topics with an N-consumer reload pool; the demo service wires
+it whenever ``sample.store.dir`` + reporter mode are both set).
 """
 
 from __future__ import annotations
@@ -13,7 +14,8 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Callable, List, Optional, Protocol
+import zlib
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from cruise_control_tpu.monitor.samples import BrokerMetricSample, PartitionMetricSample
 
@@ -107,6 +109,111 @@ class FileSampleStore:
                             on_broker(BrokerMetricSample.from_dict(json.loads(line)))
                             n += 1
         return n
+
+    def close(self) -> None:
+        pass
+
+
+class LogSampleStore:
+    """Sample store over the partitioned-log ``Transport`` SPI — the
+    KafkaSampleStore shape (``KafkaSampleStore.java:82-504``).
+
+    The reference persists accepted samples to TWO Kafka topics (partition
+    samples + broker/model-training samples), partitioned by entity hash,
+    and on startup replays both with a pool of N consumers, each owning a
+    round-robin slice of the partitions.  Here the two topics are two
+    ``Transport`` logs (same SPI the metrics reporter publishes over, so a
+    FileTransport directory gives durable restart/resume), the partitioner
+    is the same entity hash, and the reload pool is ``num_loaders`` threads
+    polling their partition slice — applies are serialized through one lock
+    because unlike the reference's aggregator our replay callbacks make no
+    thread-safety promise.  Retention is the transport's concern (Kafka
+    topic retention in the reference; FileTransport keeps everything).
+    """
+
+    def __init__(self, partition_transport, broker_transport,
+                 num_loaders: int = 8,
+                 max_records_per_partition: int = 100_000):
+        self._pt = partition_transport
+        self._bt = broker_transport
+        self.num_loaders = max(1, num_loaders)
+        self._apply_lock = threading.Lock()
+        # Retention (the role Kafka topic retention plays for the
+        # reference's sample topics): without it the logs — and every
+        # restart's replay — grow linearly with service age.  Counts are
+        # tracked in memory after a lazy initial scan; partitions are
+        # trimmed to half the cap when they exceed it.
+        self.max_records_per_partition = max_records_per_partition
+        self._counts: Dict[Tuple[int, int], int] = {}
+
+    def store_samples(self, partition_samples, broker_samples) -> None:
+        for s in partition_samples:
+            # Stable entity hash (NOT the salted builtin hash(), which moves
+            # every entity to a new partition each process generation and
+            # breaks the per-entity single-partition ordering on replay).
+            key = zlib.crc32(f"{s.topic}-{s.partition}".encode("utf-8"))
+            self._append(self._pt, 0, key % self._pt.num_partitions,
+                         json.dumps(s.to_dict()).encode("utf-8"))
+        for s in broker_samples:
+            self._append(self._bt, 1, s.broker_id % self._bt.num_partitions,
+                         json.dumps(s.to_dict()).encode("utf-8"))
+
+    def _append(self, transport, tid: int, partition: int, record: bytes) -> None:
+        transport.append(partition, record)
+        if not hasattr(transport, "truncate_tail"):
+            return
+        key = (tid, partition)
+        with self._apply_lock:
+            n = self._counts.get(key)
+            if n is None:
+                # Lazy scan AFTER the append above — already includes it.
+                n = transport.record_count(partition)
+            else:
+                n += 1
+            if n > self.max_records_per_partition:
+                transport.truncate_tail(partition,
+                                        self.max_records_per_partition // 2)
+                n = self.max_records_per_partition // 2
+            self._counts[key] = n
+
+    def load_samples(self, on_partition, on_broker) -> int:
+        from cruise_control_tpu.monitor.fetcher import (
+            DefaultMetricSamplerPartitionAssignor as assignor,
+        )
+        total = [0]
+
+        def drain(transport, partitions, parse, apply):
+            n = 0
+            for p in partitions:
+                offset = 0
+                while True:
+                    records, offset = transport.poll(p, offset)
+                    if not records:
+                        break
+                    for rec in records:
+                        sample = parse(json.loads(rec.decode("utf-8")))
+                        with self._apply_lock:
+                            apply(sample)
+                        n += 1
+            with self._apply_lock:
+                total[0] += n
+
+        threads = []
+        for transport, parse, apply in (
+                (self._pt, PartitionMetricSample.from_dict, on_partition),
+                (self._bt, BrokerMetricSample.from_dict, on_broker)):
+            for part_set in assignor.assign(transport.num_partitions,
+                                            self.num_loaders):
+                if not part_set:
+                    continue
+                t = threading.Thread(target=drain,
+                                     args=(transport, part_set, parse, apply),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join()
+        return total[0]
 
     def close(self) -> None:
         pass

@@ -38,6 +38,19 @@ class InProcessTransport:
         self._parts: List[List[bytes]] = [[] for _ in range(num_partitions)]
         self._lock = threading.Lock()
 
+    def record_count(self, partition: int) -> int:
+        with self._lock:
+            return len(self._parts[partition % len(self._parts)])
+
+    def truncate_tail(self, partition: int, keep_records: int) -> None:
+        """Drop everything but the newest ``keep_records`` (retention — the
+        role Kafka topic retention plays for the reference's metrics/sample
+        topics).  Invalidates outstanding poll offsets for the partition, so
+        only offset-free consumers (replay-from-zero stores) may use it."""
+        with self._lock:
+            log = self._parts[partition % len(self._parts)]
+            del log[:-keep_records]
+
     @property
     def num_partitions(self) -> int:
         return len(self._parts)
@@ -74,6 +87,36 @@ class FileTransport:
         with self._lock, open(self._path(partition), "ab") as f:
             f.write(_LEN.pack(len(record)))
             f.write(record)
+
+    def record_count(self, partition: int) -> int:
+        n = 0
+        offset = 0
+        while True:
+            records, offset = self.poll(partition, offset)
+            if not records:
+                return n
+            n += len(records)
+
+    def truncate_tail(self, partition: int, keep_records: int) -> None:
+        """Rewrite the segment keeping the newest ``keep_records`` (see
+        InProcessTransport.truncate_tail for the offset-invalidation
+        contract)."""
+        tail: List[bytes] = []
+        offset = 0
+        while True:
+            records, offset = self.poll(partition, offset)
+            if not records:
+                break
+            tail.extend(records)
+            tail = tail[-keep_records:]
+        path = self._path(partition)
+        tmp = path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                for rec in tail:
+                    f.write(_LEN.pack(len(rec)))
+                    f.write(rec)
+            os.replace(tmp, path)
 
     def poll(self, partition: int, offset: int,
              max_records: int = 10_000) -> Tuple[List[bytes], int]:

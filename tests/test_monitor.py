@@ -28,7 +28,10 @@ from cruise_control_tpu.monitor.metadata import (
 )
 from cruise_control_tpu.monitor.sample_store import FileSampleStore
 from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
-from cruise_control_tpu.monitor.samples import PartitionMetricSample
+from cruise_control_tpu.monitor.samples import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+)
 from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner, RunnerState
 
 W = 1000  # small window for tests
@@ -213,6 +216,50 @@ def test_sample_store_roundtrip(tmp_path):
     assert len(got) == 1
     assert got[0].topic == "t"
     assert got[0].metrics[md.CPU_USAGE] == pytest.approx(0.5)
+
+
+def test_log_sample_store_restart_resume(tmp_path):
+    """KafkaSampleStore semantics over the transport SPI: samples stored by
+    one process generation are replayed by the next (fresh store over the
+    same logs), with the multi-consumer reload pool."""
+    from cruise_control_tpu.monitor.sample_store import LogSampleStore
+    from cruise_control_tpu.reporter import FileTransport
+
+    def make_store():
+        return LogSampleStore(
+            FileTransport(str(tmp_path / "p"), num_partitions=4),
+            FileTransport(str(tmp_path / "b"), num_partitions=4),
+            num_loaders=3)
+
+    store = make_store()
+    psamples = []
+    for i in range(10):
+        s = PartitionMetricSample(broker_id=i % 3, topic=f"t{i % 4}",
+                                  partition=i, time_ms=100.0 + i)
+        s.record(md.CPU_USAGE, 0.1 * i)
+        psamples.append(s)
+    b = BrokerMetricSample(broker_id=2, time_ms=50.0)
+    b.record(md.CPU_USAGE, 0.7)
+    store.store_samples(psamples, [b])
+
+    # "Restart": a brand-new store instance over the same log directories.
+    got_p, got_b = [], []
+    n = make_store().load_samples(got_p.append, got_b.append)
+    assert n == 11
+    assert {(s.topic, s.partition) for s in got_p} == \
+        {(s.topic, s.partition) for s in psamples}
+    assert len(got_b) == 1 and got_b[0].broker_id == 2
+    assert got_b[0].metrics[md.CPU_USAGE] == pytest.approx(0.7)
+
+    # Appends after the reload land on the next reload (log positions are
+    # per-reload, not global — the reference reloads from offset 0 too).
+    store2 = make_store()
+    extra = PartitionMetricSample(broker_id=0, topic="late", partition=99,
+                                  time_ms=500.0)
+    extra.record(md.CPU_USAGE, 1.0)
+    store2.store_samples([extra], [])
+    got2 = []
+    assert make_store().load_samples(got2.append, lambda x: None) == 12
 
 
 def test_task_runner_states_and_pause():

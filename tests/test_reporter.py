@@ -6,6 +6,8 @@ Reference models: MetricSerdeTest, CruiseControlMetricsReporterTest (sans
 embedded broker), MetricFetcherManagerTest, PrometheusMetricSamplerTest.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -81,6 +83,32 @@ def test_raw_type_inventory_matches_reference():
     assert len(v5) - len(v4) == 20   # the 20 percentile types arrive in v5
 
 
+_REFERENCE_ENUM = ("/root/reference/cruise-control-metrics-reporter/src/main/"
+                   "java/com/linkedin/kafka/cruisecontrol/metricsreporter/"
+                   "metric/RawMetricType.java")
+
+
+@pytest.mark.skipif(not os.path.exists(_REFERENCE_ENUM),
+                    reason="reference tree not mounted")
+def test_raw_type_inventory_is_exhaustive_vs_reference_source():
+    """Parse the reference enum itself: our inventory must match it entry for
+    entry — name, wire id, scope, supported-since version.  This pins the
+    'complete inventory' claim to the reference source, not to a hardcoded
+    count (RawMetricType.java defines ids 0..62: 63 types total — its enum
+    body ends at BROKER_LOG_FLUSH_TIME_MS_999TH(BROKER, 62, 5))."""
+    import re
+    src = open(_REFERENCE_ENUM, encoding="utf-8").read()
+    pat = re.compile(r"^\s+([A-Z_0-9]+)\((BROKER|TOPIC|PARTITION),\s*"
+                     r"\(byte\)\s*(\d+)(?:,\s*\(byte\)\s*(\d+))?\)", re.M)
+    ref = {m.group(1): (m.group(2).lower(), int(m.group(3)),
+                        int(m.group(4)) if m.group(4) else -1)
+           for m in pat.finditer(src)}
+    assert ref, "failed to parse reference enum"
+    ours = {t.name: (t.scope.value, t.wire_id, t.supported_since)
+            for t in RawMetricType}
+    assert ours == ref
+
+
 def test_reporter_emits_full_inventory():
     backend = _backend()
     transport = InProcessTransport(num_partitions=4)
@@ -132,6 +160,29 @@ def test_file_transport_round_trip(tmp_path):
     result = sampler.get_samples(backend.fetch(), 0.0, 10_000.0)
     assert len(result.broker_samples) == 3
     assert len(result.partition_samples) == 9
+
+
+def test_consumer_offsets_survive_restart(tmp_path):
+    """Committed consumer positions (the reference's Kafka consumer-group
+    offsets): a NEW sampler over the same durable bus must not re-ingest
+    history, only records appended after the last commit."""
+    transport = FileTransport(str(tmp_path / "bus"), num_partitions=2)
+    offsets = str(tmp_path / "offsets.json")
+    backend = _backend()
+    _report_all(backend, transport, 5_000.0)
+    s1 = ConsumingMetricSampler(transport, num_fetchers=2,
+                                offsets_path=offsets)
+    assert len(s1.get_samples(backend.fetch(), 0.0, 10_000.0)
+               .partition_samples) == 9
+
+    # "Restart": a fresh sampler; the old records must NOT come back.
+    s2 = ConsumingMetricSampler(transport, num_fetchers=2,
+                                offsets_path=offsets)
+    assert not s2.get_samples(backend.fetch(), 0.0, 10_000.0).partition_samples
+    # New records do.
+    _report_all(backend, transport, 15_000.0)
+    assert len(s2.get_samples(backend.fetch(), 10_000.0, 20_000.0)
+               .partition_samples) == 9
 
 
 def test_prometheus_sampler_with_fake_adapter():
