@@ -76,26 +76,54 @@ def build_app(config: CruiseControlConfig,
         min_samples_per_window=config["min.samples.per.partition.metrics.window"],
     )
     store_dir = config.get("sample.store.dir")
-    store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
     mode = config.get("metric.sampler.mode", "synthetic")
+    if store_dir and mode == "reporter":
+        # KafkaSampleStore shape: accepted samples ride the same
+        # partitioned-log SPI the reporter publishes on, so a restart
+        # replays them with the N-consumer reload (monitor/sample_store.py
+        # LogSampleStore; reference KafkaSampleStore.java:82-504).
+        import os as _os
+        from cruise_control_tpu.monitor.sample_store import LogSampleStore
+        from cruise_control_tpu.reporter import FileTransport
+        store = LogSampleStore(
+            FileTransport(_os.path.join(store_dir, "partition-samples")),
+            FileTransport(_os.path.join(store_dir, "broker-samples")),
+            num_loaders=config["num.metric.fetchers"])
+    elif store_dir:
+        store = FileSampleStore(store_dir)
+    else:
+        store = NoopSampleStore()
     reporters = []
     if mode == "reporter":
         # Full ingestion edge: per-broker reporter agents → transport →
-        # fan-out consuming sampler (the metrics-reporter pipeline).
+        # fan-out consuming sampler (the metrics-reporter pipeline).  With a
+        # store dir the metrics bus itself is durable too.
         from cruise_control_tpu.monitor.fetcher import ConsumingMetricSampler
         from cruise_control_tpu.reporter import (
             DemoBrokerMetricsSource,
+            FileTransport,
             InProcessTransport,
             MetricsReporter,
         )
-        transport = InProcessTransport(num_partitions=8)
+        offsets_path = None
+        if store_dir:
+            import os as _os
+            transport = FileTransport(_os.path.join(store_dir, "metrics"),
+                                      num_partitions=8)
+            # Durable bus needs durable consumer positions or every restart
+            # re-ingests the whole historical log into the current window.
+            offsets_path = _os.path.join(store_dir,
+                                         "metrics-consumer-offsets.json")
+        else:
+            transport = InProcessTransport(num_partitions=8)
         source = DemoBrokerMetricsSource(backend)
         interval = config["metric.sampling.interval.ms"]
         reporters = [MetricsReporter(b.broker_id, source, transport,
                                      reporting_interval_ms=interval / 2)
                      for b in backend.fetch().brokers]
         sampler = ConsumingMetricSampler(
-            transport, num_fetchers=config["num.metric.fetchers"])
+            transport, num_fetchers=config["num.metric.fetchers"],
+            offsets_path=offsets_path)
     elif mode == "prometheus":
         from cruise_control_tpu.monitor.prometheus import PrometheusMetricSampler
         sampler = PrometheusMetricSampler(
@@ -134,9 +162,17 @@ def build_app(config: CruiseControlConfig,
             config["proposal.expiration.ms"] / 1000.0)
     ssl_on = config["webserver.ssl.enable"]
     if ssl_on and not config["webserver.ssl.certfile"]:
+        hint = ""
+        if any(k.startswith("webserver.ssl.keystore")
+               for k in config.originals):
+            hint = (" (found reference-style webserver.ssl.keystore.* keys: "
+                    "this port serves TLS from PEM files — export the "
+                    "keystore to PEM and set webserver.ssl.certfile/"
+                    "webserver.ssl.keyfile; see docs/CONFIGURATION.md)")
         raise ConfigError(
             "webserver.ssl.enable=true requires webserver.ssl.certfile — "
-            "refusing to silently serve the control plane over plain HTTP")
+            "refusing to silently serve the control plane over plain HTTP"
+            + hint)
     app = CruiseControlApp(
         cc,
         host=config["webserver.http.address"],
@@ -164,6 +200,26 @@ def _security_provider(config: CruiseControlConfig):
         if not secret:
             raise ValueError("webserver.auth.jwt.secret required for jwt provider")
         return sec.JwtSecurityProvider(secret)
+    if kind == "spnego":
+        validator_path = config["webserver.auth.spnego.validator.class"]
+        if not validator_path:
+            raise ValueError(
+                "webserver.auth.spnego.validator.class required for the "
+                "spnego provider (a GSSAPI-backed ticket validator)")
+        creds = config["webserver.auth.credentials.file"]
+        if not creds:
+            # The reference's SPNEGO provider authorizes via its user store
+            # (SpnegoUserStoreAuthorizationService); without one, every
+            # authenticated-but-unknown principal would need a default role,
+            # and defaulting valid-ticket strangers to USER grants them read
+            # access the reference denies with 403.
+            raise ValueError(
+                "webserver.auth.credentials.file required for the spnego "
+                "provider (the user store that maps principals to roles)")
+        from cruise_control_tpu.config.config_def import get_configured_instance
+        validator = get_configured_instance(validator_path)
+        return sec.SpnegoSecurityProvider(
+            validator, credentials_file=creds, default_role=None)
     if kind == "trusted_proxy":
         ips = [s.strip() for s in
                config["webserver.auth.trusted.proxy.ips"].split(",") if s.strip()]
