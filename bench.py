@@ -1,16 +1,26 @@
 """Benchmark: full multi-goal proposal generation wall-clock.
 
-Three BASELINE.md configs, one JSON line each (headline LAST):
+All five BASELINE.md configs, one JSON line each (headline LAST):
 
-- config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
-  64-lane vmapped scenario batch through the production
-  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack).
-- config #4: 2.6K brokers / 1M replicas, full default goal stack — the
-  north-star scale (<10 s budget on one v5e chip).
+- config #1: DeterministicCluster harness — 6 brokers / 3 racks / ~200
+  replicas, default goals (the direct comparator for a Java-side
+  ``DeterministicClusterTest``-style measurement).
+- config #2: RandomCluster 200 brokers / 50K replicas, a single
+  ResourceDistributionGoal (``RandomCluster.java:55-121`` driven as in
+  ``RandomClusterTest``).
 - config #3 (headline): RandomCluster 200 brokers / 50K replicas, full
   hard-goal stack + distribution soft goals — comparable across rounds.
+- config #4: 2.6K brokers / 1M replicas, full default goal stack — the
+  north-star scale (<10 s budget on one v5e chip).
+- config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
+  vmapped scenario batch through the production
+  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack).
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
+``vs_java`` is absent from every line: this image carries NO JVM (see
+BASELINE.md "Java baseline status"), so the Java GoalOptimizer has never
+been timed here — configs #1/#2 exist so the ratio can be computed the day
+a JVM is available, not to fake one now.
 Wall-clock excludes one warmup solve (jit compile is cached across snapshots
 of the same size class in production).
 """
@@ -146,7 +156,33 @@ def run(backend: str) -> None:
     headline = _timed(lambda: optimizer.optimizations(state, placement, meta))
     _emit("proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
           headline, backend)
-    del state, placement, optimizer
+
+    # ---- config #1: DeterministicCluster harness (6 brokers / 3 racks /
+    # ~200 replicas, default goals — BASELINE.md config #1).
+    from cruise_control_tpu.testing import deterministic as det
+    cm = det.homogeneous_cluster({0: 0, 1: 0, 2: 1, 3: 1, 4: 2, 5: 2})
+    for p in range(100):
+        lead, foll = p % 6, (p + 1 + p % 3) % 6
+        cm.create_replica("T1", p, broker_id=lead, index=0, is_leader=True)
+        cm.create_replica("T1", p, broker_id=foll, index=1, is_leader=False)
+        cm.set_replica_load("T1", p, lead, det.load(0.5, 120.0, 180.0, 220.0))
+        cm.set_replica_load("T1", p, foll, det.load(0.1, 120.0, 0.0, 220.0))
+    d_state, d_placement, d_meta = cm.freeze(pad_replicas_to=256,
+                                             pad_brokers_to=8)
+    opt_det = GoalOptimizer(goal_names=GOALS)
+    det_s = _timed(lambda: opt_det.optimizations(d_state, d_placement, d_meta))
+    _emit("proposal_generation_wall_clock_deterministic_6brokers_200replicas",
+          det_s, backend)
+    del d_state, d_placement, opt_det
+
+    # ---- config #2: 200 brokers / 50K replicas, ONE ResourceDistributionGoal
+    # (reuses config #3's still-live snapshot and solver caches).
+    opt_single = GoalOptimizer(
+        goal_names=["NetworkInboundUsageDistributionGoal"])
+    single_s = _timed(lambda: opt_single.optimizations(state, placement, meta))
+    _emit("proposal_generation_wall_clock_200brokers_50k_replicas_single_"
+          "resource_distribution_goal", single_s, backend)
+    del state, placement, opt_single, optimizer
 
     # ---- configs #4/#5 fixture: north-star scale (2.6K brokers / 1M replicas)
     big = rc.ClusterProperties(

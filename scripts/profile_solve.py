@@ -1,9 +1,12 @@
 """Per-goal timing/rounds breakdown of the headline bench config.
 
-Usage: python scripts/profile_solve.py [cpu|tpu] [small|big]
+Usage: python scripts/profile_solve.py [cpu|tpu] [small|big] [--json PATH]
 
 Mirrors GoalOptimizer.optimizations goal-by-goal with explicit per-goal
 timing (block_until_ready between goals), after a full warmup pass.
+``--json PATH`` additionally writes the machine-readable artifact
+(per-goal warmup/steady ms, rounds, moves, violations; the committed
+profile_r{N}.json files are produced this way).
 """
 
 from __future__ import annotations
@@ -18,8 +21,14 @@ import jax
 
 
 def main() -> None:
-    want = sys.argv[1] if len(sys.argv) > 1 else "tpu"
-    size = sys.argv[2] if len(sys.argv) > 2 else "small"
+    args = list(sys.argv[1:])
+    json_path = None
+    if "--json" in args:
+        i = args.index("--json")
+        json_path = args[i + 1]
+        del args[i:i + 2]
+    want = args[0] if args else "tpu"
+    size = args[1] if len(args) > 1 else "small"
     from cruise_control_tpu.utils.hermetic import (
         enable_persistent_compilation_cache,
         force_cpu,
@@ -57,9 +66,13 @@ def main() -> None:
                          OptimizationOptions())
     solver = optimizer.solver
 
+    artifact = {"backend": backend, "size": size,
+                "cache_dir_nonempty": bool(cache_warm), "passes": {}}
+
     def one_pass(label, pl):
         total0 = time.monotonic()
         priors = []
+        rows = []
         for goal in goals:
             t0 = time.monotonic()
             pl, info = solver.optimize_goal(goal, priors, gctx, pl)
@@ -69,8 +82,16 @@ def main() -> None:
                   f"moves={info.moves_applied:6d} "
                   f"violated {info.violated_brokers_before:4d}->"
                   f"{info.violated_brokers_after:4d}")
+            rows.append({"goal": goal.name, "ms": round(dt * 1000, 1),
+                         "rounds": info.rounds,
+                         "ms_per_round": round(dt * 1000 / max(info.rounds, 1), 1),
+                         "moves": info.moves_applied,
+                         "violated_before": info.violated_brokers_before,
+                         "violated_after": info.violated_brokers_after})
             priors.append(goal)
-        print(f"{label} total={time.monotonic() - total0:.3f}s")
+        total = time.monotonic() - total0
+        print(f"{label} total={total:.3f}s")
+        artifact["passes"][label] = {"total_s": round(total, 3), "goals": rows}
         return pl
 
     print(f"backend={backend} size={size}")
@@ -81,6 +102,11 @@ def main() -> None:
     one_pass("warmup", placement)
     print("steady-state:")
     one_pass("steady", placement)
+    if json_path:
+        import json
+        with open(json_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {json_path}")
 
 
 if __name__ == "__main__":
