@@ -257,31 +257,74 @@ class BrokerSimulator:
         return {}
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    polls = 2
-    args = list(sys.argv[1:] if argv is None else argv)
-    if "--polls-to-finish" in args:
-        polls = int(args[args.index("--polls-to-finish") + 1])
-    sim = BrokerSimulator(polls_to_finish=polls)
-    out = sys.stdout
-    for line in sys.stdin:
+def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
+    """Drain one JSON-lines stream; True when a shutdown op arrived."""
+    for line in lines:
         line = line.strip()
         if not line:
             continue
         try:
             req = json.loads(line)
         except json.JSONDecodeError as e:
-            out.write(json.dumps({"ok": False, "error": f"bad json: {e}"}) + "\n")
-            out.flush()
+            write(json.dumps({"ok": False, "error": f"bad json: {e}"}) + "\n")
             continue
         if req.get("op") == "shutdown":
-            out.write(json.dumps({"id": req.get("id"), "ok": True}) + "\n")
-            out.flush()
-            return 0
+            write(json.dumps({"id": req.get("id"), "ok": True}) + "\n")
+            return True
         resp = sim.handle(req)
         resp["id"] = req.get("id")
-        out.write(json.dumps(resp) + "\n")
+        write(json.dumps(resp) + "\n")
+    return False
+
+
+def _serve_tcp(sim: "BrokerSimulator", port: int) -> int:
+    """Network-facing mode: the same JSON-lines admin protocol over a TCP
+    socket (the shape of the reference's AdminClient->broker network edge).
+    Prints the bound port on stdout so a parent with port 0 can connect.
+    One client at a time — an admin protocol, not a data plane."""
+    import socket
+
+    srv = socket.create_server(("127.0.0.1", port))
+    print(json.dumps({"listening": srv.getsockname()[1]}), flush=True)
+    try:
+        while True:
+            conn, _ = srv.accept()
+            with conn:
+                rfile = conn.makefile("r", encoding="utf-8")
+                wfile = conn.makefile("w", encoding="utf-8")
+
+                def write(s: str) -> None:
+                    wfile.write(s)
+                    wfile.flush()
+
+                try:
+                    if _serve_stream(sim, rfile, write):
+                        return 0
+                except OSError:
+                    # Unclean client disconnect (reset mid-read, broken pipe
+                    # on reply) must not kill the listener — accept the next
+                    # client; cluster state survives across connections.
+                    continue
+    finally:
+        srv.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    polls = 2
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--polls-to-finish" in args:
+        polls = int(args[args.index("--polls-to-finish") + 1])
+    sim = BrokerSimulator(polls_to_finish=polls)
+    if "--listen" in args:
+        return _serve_tcp(sim, int(args[args.index("--listen") + 1]))
+
+    out = sys.stdout
+
+    def write(s: str) -> None:
+        out.write(s)
         out.flush()
+
+    _serve_stream(sim, sys.stdin, write)
     return 0
 
 

@@ -51,6 +51,9 @@ class SubprocessClusterBackend:
         self.request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
         self._next_id = 0
+        if proc is not None:
+            self._rstream = proc.stdout
+            self._wstream = proc.stdin
         # Configs we set (entity_type, entity, name) and replica-list entries
         # we merged in — clear_throttles removes exactly these, never a
         # pre-existing operator-set throttle.
@@ -79,10 +82,11 @@ class SubprocessClusterBackend:
             self.request("shutdown")
         except BackendTransportError:
             pass
-        try:
-            self.proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
 
     # ------------------------------------------------------------ transport
 
@@ -92,8 +96,8 @@ class SubprocessClusterBackend:
             rid = self._next_id
             msg = json.dumps({"id": rid, "op": op, **kwargs})
             try:
-                self.proc.stdin.write(msg + "\n")
-                self.proc.stdin.flush()
+                self._wstream.write(msg + "\n")
+                self._wstream.flush()
             except (BrokenPipeError, OSError, ValueError) as e:
                 raise BackendTransportError(f"peer write failed: {e}") from e
             line = self._read_line()
@@ -119,16 +123,18 @@ class SubprocessClusterBackend:
         unread reply in flight, or garbage on the pipe): terminate the peer
         so the failure mode is a clean dead-peer, not an off-by-one reply
         stream."""
+        if self.proc is None:
+            return
         try:
             self.proc.kill()
         except OSError:
             pass
 
     def _read_line(self) -> str:
-        stdout = self.proc.stdout
-        ready, _, _ = select.select([stdout], [], [], self.request_timeout_s)
+        ready, _, _ = select.select([self._rstream], [],
+                                    [], self.request_timeout_s)
         if not ready:
-            alive = self.proc.poll() is None
+            alive = self.proc.poll() is None if self.proc else False
             # A late reply would desync every subsequent request (it reads
             # the previous answer); poison the peer so this stays a clean
             # transport failure.
@@ -136,7 +142,13 @@ class SubprocessClusterBackend:
             raise BackendTransportError(
                 f"no reply within {self.request_timeout_s}s "
                 f"(peer was alive={alive})")
-        line = stdout.readline()
+        try:
+            line = self._rstream.readline()
+        except OSError as e:
+            # Socket resets / mid-line timeouts are transport failures like
+            # any other, and leave the stream desynced.
+            self._poison(f"read failed: {e}")
+            raise BackendTransportError(f"peer read failed: {e}") from e
         if not line:
             raise BackendTransportError("peer closed the pipe")
         return line
@@ -336,3 +348,69 @@ class SubprocessClusterBackend:
 
     def stats(self) -> Dict:
         return self.request("stats")
+
+
+class SocketClusterBackend(SubprocessClusterBackend):
+    """The same admin driver over a TCP SOCKET — the network-facing edge.
+
+    Where SubprocessClusterBackend pipes to a child it owns, this connects
+    to an admin endpoint by address (a ``broker_simulator --listen`` peer,
+    or anything speaking the protocol), the way the reference's executor
+    reaches brokers through a networked AdminClient.  ``spawn_networked``
+    starts a listener child on an ephemeral port and connects to it —
+    executor traffic then crosses a real socket, not inherited pipes.
+    """
+
+    def __init__(self, host: str, port: int, request_timeout_s: float = 10.0,
+                 proc: Optional[subprocess.Popen] = None):
+        import socket
+
+        self._sock = socket.create_connection((host, port),
+                                              timeout=request_timeout_s)
+        # select() is the read-timeout mechanism; a lingering per-socket
+        # timeout would instead fire MID-readline on a reply split across
+        # segments and desync the stream.
+        self._sock.settimeout(None)
+        super().__init__(proc, request_timeout_s=request_timeout_s)
+        self._rstream = self._sock.makefile("r", encoding="utf-8")
+        self._wstream = self._sock.makefile("w", encoding="utf-8")
+
+    @classmethod
+    def spawn_networked(cls, partitions: Sequence[Dict],
+                        polls_to_finish: int = 2,
+                        request_timeout_s: float = 10.0) -> "SocketClusterBackend":
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "cruise_control_tpu.executor.broker_simulator",
+             "--polls-to-finish", str(polls_to_finish), "--listen", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        # The listener prints its bound port as the first line.  Any failure
+        # from here on must reap the child — an orphaned listener survives
+        # in accept() holding a port.
+        try:
+            ready, _, _ = select.select([proc.stdout], [], [],
+                                        request_timeout_s)
+            if not ready:
+                raise BackendTransportError("listener did not report a port")
+            port = int(json.loads(proc.stdout.readline())["listening"])
+            backend = cls("127.0.0.1", port,
+                          request_timeout_s=request_timeout_s, proc=proc)
+            backend.request("bootstrap", partitions=list(partitions))
+            return backend
+        except Exception:
+            proc.kill()
+            raise
+
+    def _poison(self, why: str) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        super()._poison(why)
+
+    def close(self) -> None:
+        super().close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

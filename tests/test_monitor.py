@@ -262,6 +262,28 @@ def test_log_sample_store_restart_resume(tmp_path):
     assert make_store().load_samples(got2.append, lambda x: None) == 12
 
 
+def test_log_sample_store_bounded_retention(tmp_path):
+    """Partitions are trimmed to half the cap once they exceed it, so the
+    store (and every restart's replay) stays bounded."""
+    from cruise_control_tpu.monitor.sample_store import LogSampleStore
+    from cruise_control_tpu.reporter import FileTransport
+
+    store = LogSampleStore(
+        FileTransport(str(tmp_path / "p"), num_partitions=1),
+        FileTransport(str(tmp_path / "b"), num_partitions=1),
+        max_records_per_partition=10)
+    for i in range(25):
+        s = PartitionMetricSample(broker_id=0, topic="t", partition=0,
+                                  time_ms=float(i))
+        s.record(md.CPU_USAGE, float(i))
+        store.store_samples([s], [])
+    got = []
+    store.load_samples(got.append, lambda x: None)
+    assert len(got) <= 10
+    # The NEWEST samples survive the trim.
+    assert max(s.time_ms for s in got) == 24.0
+
+
 def test_task_runner_states_and_pause():
     backend = _fake_cluster()
     lm, runner = _monitored(backend)

@@ -286,6 +286,26 @@ def test_dead_peer_during_leadership_marks_dead():
                             ExecutionTaskState.DEAD) == 1
 
 
+def test_full_rebalance_over_tcp_socket():
+    """The network-facing driver: the same rebalance rides a real TCP
+    socket to a listener peer (broker_simulator --listen)."""
+    from cruise_control_tpu.executor.subprocess_backend import (
+        SocketClusterBackend,
+    )
+    backend = SocketClusterBackend.spawn_networked(bootstrap_partitions(),
+                                                   polls_to_finish=2)
+    try:
+        ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+        ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1]),
+                              proposal("T", 2, [2, 3], [3, 2])], wait=True)
+        final = {(d["topic"], d["partition"]): d
+                 for d in backend.describe_topics()}
+        assert final[("T", 0)]["replicas"] == [2, 1]
+        assert final[("T", 2)]["leader"] == 3
+    finally:
+        backend.close()
+
+
 def test_simulator_main_stdio_roundtrip():
     """The __main__ stdio framing itself (bad json, shutdown rc=0)."""
     proc = subprocess.Popen(
