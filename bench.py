@@ -143,7 +143,7 @@ def run(backend: str) -> None:
     # processes and warns that loading mismatched AOT results "could lead to
     # execution errors such as SIGILL" — the benchmark artifact must never
     # die to a stale cache entry.  (scripts/profile_solve.py opts in.)
-    cache_warm = False
+    # "warm" below therefore always means the IN-PROCESS jit cache.
 
     # ---- config #3 (headline) first, so a number exists even if the harness
     # cuts the run short; re-emitted last for tail parsers.
@@ -224,7 +224,21 @@ def run(backend: str) -> None:
           per_lane_vs_budget=round(
               NORTH_STAR_BUDGET_S / max(batch_s / lanes, 1e-9), 3),
           lanes=lanes, includes_compile=True,
-          compile_cache="warm" if cache_warm else "cold")
+          compile_cache="cold")
+    # Warm repeat: the in-process jit cache now holds every lane program —
+    # this is what the precompute daemon's steady state (and any repeat
+    # what-if at the same size class) pays.
+    sets_w = [[lanes + b] for b in range(lanes)]
+    t0 = time.monotonic()
+    opt_hard.batch_remove_scenarios(h_state, h_placement, h_meta, sets_w,
+                                    num_candidates=512)
+    warm_s = time.monotonic() - t0
+    _emit("remove_broker_what_ifs_2600brokers_1m_replicas_hard_goals_warm",
+          warm_s, backend, value_per_lane=round(warm_s / lanes, 4),
+          per_lane_vs_budget=round(
+              NORTH_STAR_BUDGET_S / max(warm_s / lanes, 1e-9), 3),
+          lanes=lanes, includes_compile=False,
+          compile_cache="warm")
     del h_state, h_placement, opt_hard
 
     # Headline repeated LAST: the driver's artifact parser takes the tail line.

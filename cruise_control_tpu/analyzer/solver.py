@@ -277,14 +277,27 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
               ridx, force_exact=None):
-        state = gctx.state
-        b = state.num_brokers_padded
         c = num_candidates
         score = score_fn(gctx, placement, agg)
         top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
                                           force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
+        # Zero-candidate rounds skip the whole C×B tile.  Only in UNBATCHED
+        # solves: under the what-if vmap the predicate is lane-dependent, so
+        # XLA lowers the cond to a select and runs both branches — the skip
+        # is inert there, not wrong.
+        return jax.lax.cond(
+            jnp.any(is_cand),
+            lambda pl, ag: _phase_body(gctx, pl, ag, ridx, top_score, cand,
+                                       is_cand),
+            lambda pl, ag: (pl, ag, jnp.int32(0)),
+            placement, agg)
 
+    def _phase_body(gctx: GoalContext, placement: Placement, agg: Aggregates,
+                    ridx, top_score, cand, is_cand):
+        state = gctx.state
+        b = state.num_brokers_padded
+        c = num_candidates
         r2 = cand[:, None]
         d2 = jnp.arange(b)[None, :]
         ok = accept(gctx, placement, agg, r2, d2)
@@ -412,13 +425,22 @@ def _leadership_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int):
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
               ridx, force_exact=None):
         del ridx    # promotions carry no tie-breaking jitter
-        state = gctx.state
         c = num_candidates
         score = goal.leadership_candidate_score(gctx, placement, agg)
         top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
                                           force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
+        return jax.lax.cond(
+            jnp.any(is_cand),
+            lambda pl, ag: _leadership_body(gctx, pl, ag, top_score, cand,
+                                            is_cand),
+            lambda pl, ag: (pl, ag, jnp.int32(0)),
+            placement, agg)
 
+    def _leadership_body(gctx: GoalContext, placement: Placement,
+                         agg: Aggregates, top_score, cand, is_cand):
+        state = gctx.state
+        c = num_candidates
         ok = (is_cand & accept(gctx, placement, agg, cand)
               & goal.leadership_self_ok(gctx, placement, agg, cand))
         old = current_leader_of(gctx, placement, state.partition[cand])  # i32[C]
@@ -521,15 +543,29 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
 
     def phase(gctx: GoalContext, placement: Placement, agg: Aggregates,
               ridx, force_exact=None):
-        state = gctx.state
         c = num_candidates
-        b = state.num_brokers_padded
         out_top, out_c = _top_candidates(
             goal.swap_out_score(gctx, placement, agg, ridx), c,
             exact=goal.is_hard, force_exact=force_exact)
         in_top, in_c = _top_candidates(
             goal.swap_in_score(gctx, placement, agg, ridx), c,
             exact=goal.is_hard, force_exact=force_exact)
+        # No exchange possible without candidates on BOTH sides — skip the
+        # C×C pair tile entirely (see _replica_phase).
+        any_pair = (jnp.any(out_top > _SCORE_FLOOR)
+                    & jnp.any(in_top > _SCORE_FLOOR))
+        return jax.lax.cond(
+            any_pair,
+            lambda pl, ag: _swap_body(gctx, pl, ag, ridx, out_top, out_c,
+                                      in_top, in_c),
+            lambda pl, ag: (pl, ag, jnp.int32(0)),
+            placement, agg)
+
+    def _swap_body(gctx: GoalContext, placement: Placement, agg: Aggregates,
+                   ridx, out_top, out_c, in_top, in_c):
+        state = gctx.state
+        c = num_candidates
+        b = state.num_brokers_padded
 
         ro = out_c[:, None]                      # [C,1]
         ri = in_c[None, :]                       # [1,C]
@@ -546,7 +582,21 @@ def _swap_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         pos = jnp.arange(c, dtype=jnp.int32)[None, :]
         cost = jnp.where(ok, _jittered(cost_raw, ok, out_c, pos, ridx,
                                        frac=jitter_frac), _INF_COST)
-        sel = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        # Rank matching (same mechanism as the replica phase's destination
+        # assignment): the i-th out-candidate gets the i-th cheapest partner
+        # COLUMN — distinct partners by construction.  Jitter alone cannot
+        # spread rows when a few partners are distinctly cheapest (measured
+        # at north-star scale: 1024 feasible rows argmin onto ~35 partners
+        # on the 4 deepest-gap brokers, so in-partner uniqueness kept 35 of
+        # 1024 and the deficient-broker tail burned ~20 rounds).  Rows whose
+        # assigned pair is infeasible fall back to their own argmin.
+        proxy = jnp.min(cost, axis=0)                        # f32[C] per-partner
+        # (ranked already has length c — row i simply takes rank i, unlike
+        # the replica phase where B != C forces a wrap.)
+        assign = jnp.argsort(proxy).astype(jnp.int32)        # cheap → expensive
+        ok_assign = jnp.take_along_axis(ok, assign[:, None], axis=1)[:, 0]
+        fallback = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        sel = jnp.where(ok_assign, assign, fallback)
         feasible = jnp.take_along_axis(ok, sel[:, None], axis=1)[:, 0]
 
         r_in_sel = in_c[sel]

@@ -367,10 +367,12 @@ class SocketClusterBackend(SubprocessClusterBackend):
 
         self._sock = socket.create_connection((host, port),
                                               timeout=request_timeout_s)
-        # select() is the read-timeout mechanism; a lingering per-socket
-        # timeout would instead fire MID-readline on a reply split across
-        # segments and desync the stream.
-        self._sock.settimeout(None)
+        # Keep a socket timeout as the mid-line backstop: select() only
+        # bounds time-to-FIRST-byte, so a peer stalling after half a reply
+        # would otherwise block readline() forever with self._lock held.  A
+        # mid-line timeout raises OSError in _read_line, which poisons the
+        # stream — the desync is moot because the peer is killed.
+        self._sock.settimeout(request_timeout_s)
         super().__init__(proc, request_timeout_s=request_timeout_s)
         self._rstream = self._sock.makefile("r", encoding="utf-8")
         self._wstream = self._sock.makefile("w", encoding="utf-8")
@@ -392,7 +394,14 @@ class SocketClusterBackend(SubprocessClusterBackend):
                                         request_timeout_s)
             if not ready:
                 raise BackendTransportError("listener did not report a port")
-            port = int(json.loads(proc.stdout.readline())["listening"])
+            first = proc.stdout.readline()
+            try:
+                port = int(json.loads(first)["listening"])
+            except (ValueError, KeyError, TypeError) as e:
+                # Child died before/while printing the port (EOF reads as
+                # ''): a transport failure, not a parse bug.
+                raise BackendTransportError(
+                    f"bad listener banner {first!r}: {e}") from e
             backend = cls("127.0.0.1", port,
                           request_timeout_s=request_timeout_s, proc=proc)
             backend.request("bootstrap", partitions=list(partitions))
