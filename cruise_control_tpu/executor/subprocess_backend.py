@@ -99,6 +99,10 @@ class SubprocessClusterBackend:
                 self._wstream.write(msg + "\n")
                 self._wstream.flush()
             except (BrokenPipeError, OSError, ValueError) as e:
+                # A write timeout (possible now that sockets carry one)
+                # leaves an indeterminate partial frame on a possibly-live
+                # peer — poison so the desync cannot corrupt later requests.
+                self._poison(f"write failed: {e}")
                 raise BackendTransportError(f"peer write failed: {e}") from e
             line = self._read_line()
             try:
