@@ -85,10 +85,14 @@ class Goal:
     # AGGREGATE state (partition-/source-local predicates only) — exempts it
     # from the trace-time dst-slack invariant check below.
     dst_slack_exempt: bool = False
-    # Optional cap on the candidate-tile width for this goal's move phases.
-    # Band-bounded goals keep far fewer moves per round than the structural
-    # goals' default width, so a narrower tile cuts the dominant C×B
-    # feasibility cost without costing rounds.  None = solver default.
+    # Optional candidate-tile width for this goal's move phases.  Narrowing
+    # hints always apply (band-bounded goals keep far fewer moves per round
+    # than the default width, so a narrower tile cuts the dominant C×B
+    # feasibility cost without costing rounds).  A hint ABOVE the solver's
+    # configured cap is honored only when this goal also declares
+    # ``dst_prune_score`` and destination tiling is enabled — the solver
+    # bounds the widened pair-tile area to what the cap already implies
+    # (GoalSolver._width).  None = solver default.
     candidate_width_hint: Optional[int] = None
 
     def key(self) -> str:
@@ -141,6 +145,19 @@ class Goal:
         after = agg.broker_load[dst] + load
         frac = after / jnp.maximum(gctx.state.capacity[dst], 1e-9)
         return jnp.sum(frac, axis=-1)
+
+    def dst_prune_score(self, gctx: GoalContext, placement: Placement,
+                        agg: Aggregates):
+        """Optional f32[B], higher = more attractive destination.
+
+        Declaring it lets the solver restrict this goal's move-phase pair
+        tile to the top-D brokers (rack-stratified, solver
+        ``max_dst_candidates``) instead of all B — the C×B matrices are the
+        dominant solve cost at north-star scale.  Pruning is a per-round
+        heuristic, not a constraint: anything missed is re-scored against
+        fresh aggregates next round, and the stall/polish safety nets catch
+        residuals.  None (default) = scan every broker."""
+        return None
 
     def accept_replica_move(self, gctx: GoalContext, placement: Placement,
                             agg: Aggregates, r, dst):

@@ -96,6 +96,12 @@ class ReplicaDistributionGoal(Goal):
         del r
         return self._counts(gctx, agg)[dst].astype(jnp.float32)
 
+    def dst_prune_score(self, gctx, placement, agg):
+        """Count headroom: receivers are the lowest-count brokers."""
+        upper, _ = self._bounds(gctx, agg)
+        head = (upper - self._counts(gctx, agg)).astype(jnp.float32)
+        return jnp.where(alive_mask(gctx), head, -jnp.inf)
+
     def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
         upper, _ = self._bounds(gctx, agg)
         w = self._count_weight(cand_load, is_lead_cand)

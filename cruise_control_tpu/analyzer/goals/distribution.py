@@ -139,6 +139,12 @@ class ResourceDistributionGoal(Goal):
         after = agg.broker_load[dst, res] + load
         return after / jnp.maximum(gctx.state.capacity[dst, res], 1e-9)
 
+    def dst_prune_score(self, gctx, placement, agg):
+        """Band headroom: a round only ever fills the emptiest receivers."""
+        upper, _, _ = self._bounds(gctx, agg)
+        head = upper - agg.broker_load[:, self.resource]
+        return jnp.where(alive_mask(gctx), head, -jnp.inf)
+
     def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
         upper, _, _ = self._bounds(gctx, agg)
         return cand_load[:, self.resource], upper - agg.broker_load[:, self.resource]

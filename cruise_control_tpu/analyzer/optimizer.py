@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
-from cruise_control_tpu.analyzer.context import build_context, compute_aggregates
+from cruise_control_tpu.analyzer.context import build_context
 from cruise_control_tpu.analyzer.goals.base import Goal
 from cruise_control_tpu.analyzer.goals.registry import (
     DEFAULT_GOALS,
@@ -208,11 +208,9 @@ class GoalOptimizer:
         gctx, placement = self.solver.shard_inputs(gctx, placement)
         initial = placement
 
-        agg0 = compute_aggregates(gctx, placement)
-        violated_before = [
-            g.name for g in goals
-            if int(np.sum(np.asarray(g.violated_brokers(gctx, placement, agg0)))) > 0
-        ]
+        agg0 = self.solver.aggregates(gctx, placement)
+        vio0 = self.solver.violations(goals, gctx, placement, agg0)
+        violated_before = [g.name for g, v in zip(goals, vio0) if v > 0]
         stats_before = compute_stats(state, placement, self.constraint.balance_threshold)
 
         # AbstractGoal.java:108-117: the stats-must-not-worsen contract is
@@ -234,16 +232,16 @@ class GoalOptimizer:
 
         infos: List[GoalOptimizationInfo] = []
         priors: List[Goal] = []
+        agg = agg0
         for goal in goals:
-            placement, info = self.solver.optimize_goal(goal, priors, gctx, placement)
+            placement, agg, info = self.solver.optimize_goal(
+                goal, priors, gctx, placement, agg)
             infos.append(info)
             stranded = 0
             if goal.is_hard and goal.uses_replica_moves:
                 # Goals that cannot relocate replicas across brokers (intra-disk,
                 # leadership-only) are not responsible for dead-broker evacuation.
-                from cruise_control_tpu.analyzer.context import currently_offline
-                stranded = int(np.sum(np.asarray(
-                    currently_offline(gctx, placement))))
+                stranded = info.stranded_after
             try:
                 check_hard_goal(goal, info, stranded)
             except OptimizationFailureError:
@@ -277,16 +275,16 @@ class GoalOptimizer:
         satisfied_own_pass = {i.goal_name for i in infos
                               if i.violated_brokers_after == 0}
         for _ in range(self.polish_passes):
-            aggP = compute_aggregates(gctx, placement)
-            revio = [g for g in goals
+            vioP = self.solver.violations(goals, gctx, placement, agg)
+            revio = [g for g, v in zip(goals, vioP)
                      if not g.is_hard and g.name in satisfied_own_pass
-                     and int(np.sum(np.asarray(
-                         g.violated_brokers(gctx, placement, aggP)))) > 0]
+                     and v > 0]
             if not revio:
                 break
             for goal in revio:
-                placement, pinfo = self.solver.optimize_goal(
-                    goal, [p for p in goals if p is not goal], gctx, placement)
+                placement, agg, pinfo = self.solver.optimize_goal(
+                    goal, [p for p in goals if p is not goal], gctx, placement,
+                    agg)
                 for i, inf in enumerate(infos):
                     if inf.goal_name == goal.name:
                         inf.rounds += pinfo.rounds
@@ -294,11 +292,10 @@ class GoalOptimizer:
                         inf.violated_brokers_after = pinfo.violated_brokers_after
                         inf.metric_after = pinfo.metric_after
 
-        aggN = compute_aggregates(gctx, placement)
-        violated_after = [
-            g.name for g in goals
-            if int(np.sum(np.asarray(g.violated_brokers(gctx, placement, aggN)))) > 0
-        ]
+        # `agg` is exact here: every solve returns a fresh full recompute and
+        # the placement has not changed since the last one.
+        vioN = self.solver.violations(goals, gctx, placement, agg)
+        violated_after = [g.name for g, v in zip(goals, vioN) if v > 0]
         stats_after = compute_stats(state, placement, self.constraint.balance_threshold)
         proposals = diff_proposals(state, initial, placement, meta)
 

@@ -55,30 +55,80 @@ def diff_proposals(
     ends = np.searchsorted(sorted_part, changed_parts, side="right")
 
     broker_ids = np.asarray(meta.broker_ids)
+    # The assembly loop below is pure Python over ~#changed partitions; at
+    # north-star scale that is tens of thousands of iterations, so every
+    # per-replica numpy scalar index matters.  Compact the sorted view down
+    # to ONLY the changed partitions' rows first (a goal pass that touches 10
+    # partitions must not pay O(R) Python-list conversion), then precompute
+    # each field as a Python list in one vectorized pass and intern the
+    # (broker, logdir) info objects (a few thousand distinct values vs 100K+
+    # replicas).  Per-partition sizes come from reduceat over the compacted
+    # view (sentinel keeps the final boundary valid).
+    lengths = ends - starts
+    bounds = np.zeros(part.size + 1, dtype=np.int64)
+    np.add.at(bounds, starts, 1)
+    np.add.at(bounds, ends, -1)
+    in_seg = np.cumsum(bounds[:-1]) > 0
+    sel = order[in_seg]                      # changed partitions' rows, sorted
+    new_ends = np.cumsum(lengths)
+    new_starts = (new_ends - lengths).tolist()
+    new_ends = new_ends.tolist()
+
+    gb0 = broker_ids[b0[sel]].tolist()
+    gb1 = broker_ids[b1[sel]].tolist()
+    ld0 = d0[sel].tolist() if has_disks else None
+    ld1 = d1[sel].tolist() if has_disks else None
+    ll0 = l0[sel].tolist()
+    ll1 = l1[sel].tolist()
+    csize = disk_size[sel]
+    pairs = np.stack([new_starts, new_ends], axis=1).ravel()
+    sorted_sizes = np.append(csize, csize.dtype.type(0))
+    sizes = np.maximum.reduceat(sorted_sizes, pairs)[::2].tolist()
+
+    info_cache = {}
+
+    def info(bid: int, dk) -> ReplicaPlacementInfo:
+        key = (bid, dk)
+        r = info_cache.get(key)
+        if r is None:
+            r = info_cache[key] = ReplicaPlacementInfo(bid, dk)
+        return r
+
+    topics = meta.topics
+    partitions = meta.partitions
     proposals: List[ExecutionProposal] = []
-    for p, s, e in zip(changed_parts.tolist(), starts.tolist(), ends.tolist()):
-        rows = order[s:e]
-        t_idx, p_num = meta.partitions[p]
-        tp = TopicPartition(meta.topics[t_idx], p_num)
+    # ``rows`` below are POSITIONS into the compacted per-field lists.
+    for p, s, e, size in zip(changed_parts.tolist(), new_starts,
+                             new_ends, sizes):
+        rows = range(s, e)
+        t_idx, p_num = partitions[p]
+        tp = TopicPartition(topics[t_idx], p_num)
 
-        def placement_info(r: int, brokers, disks) -> ReplicaPlacementInfo:
-            return ReplicaPlacementInfo(
-                int(broker_ids[brokers[r]]),
-                int(disks[r]) if has_disks else None)
+        if has_disks:
+            old_list = [info(gb0[r], ld0[r]) for r in rows]
+        else:
+            old_list = [info(gb0[r], None) for r in rows]
+        old_leader = old_list[0]
+        for i, r in enumerate(rows):
+            if ll0[r]:
+                old_leader = old_list[i]
+                break
 
-        old_list = [placement_info(r, b0, d0) for r in rows]
-        old_leader_rows = [r for r in rows if l0[r]]
-        old_leader = (placement_info(old_leader_rows[0], b0, d0)
-                      if old_leader_rows else old_list[0])
-
-        new_leader_rows = [r for r in rows if l1[r]]
-        lead_row = new_leader_rows[0] if new_leader_rows else rows[0]
-        new_list = ([placement_info(lead_row, b1, d1)]
-                    + [placement_info(r, b1, d1) for r in rows if r != lead_row])
+        lead_row = rows[0]
+        for r in rows:
+            if ll1[r]:
+                lead_row = r
+                break
+        if has_disks:
+            new_list = ([info(gb1[lead_row], ld1[lead_row])]
+                        + [info(gb1[r], ld1[r]) for r in rows if r != lead_row])
+        else:
+            new_list = ([info(gb1[lead_row], None)]
+                        + [info(gb1[r], None) for r in rows if r != lead_row])
 
         proposals.append(ExecutionProposal(
             topic_partition=tp,
-            partition_size=float(disk_size[rows].max(initial=0.0)),
+            partition_size=float(size),
             old_leader=old_leader,
             old_replicas=tuple(old_list),
             new_replicas=tuple(new_list),

@@ -36,6 +36,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cruise_control_tpu.analyzer.context import (
     Aggregates,
@@ -100,6 +101,9 @@ class GoalOptimizationInfo:
     leadership_moves: int = 0
     violated_brokers_before: int = 0
     violated_brokers_after: int = 0
+    # Offline (dead-broker) replicas still stranded when the goal's loop
+    # exited — consumed by the optimizer's hard-goal evacuation check.
+    stranded_after: int = 0
     metric_before: float = 0.0
     metric_after: float = 0.0
 
@@ -260,12 +264,43 @@ def _check_dst_slack_invariant(goal: Goal, priors: Sequence[Goal]) -> None:
                 "reads no destination aggregates)")
 
 
+def _stratified_top_dst(gctx: GoalContext, pscore: jnp.ndarray,
+                        d: int) -> jnp.ndarray:
+    """i32[d]: the d most attractive destination brokers, round-robin across
+    racks by within-rack rank.
+
+    Plain global top-d could prune an entire rack out of the tile (e.g. one
+    hot rack), silently making rack-constrained moves infeasible this round.
+    Taking every rack's best broker first, then every rack's second-best,
+    etc., guarantees each rack keeps ~d/num_racks slots, so any
+    rack-placement-feasible move keeps a destination in the tile; dead or
+    invalid brokers ride along with -inf scores and are culled by the
+    feasibility mask like any other infeasible pair."""
+    bn = pscore.shape[0]
+    order = jnp.argsort(-pscore).astype(jnp.int32)           # best first
+    rack_sorted = gctx.state.rack[order]                     # i32[B]
+    onehot = (rack_sorted[:, None]
+              == jnp.arange(gctx.num_racks, dtype=jnp.int32)[None, :])
+    cnt = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    rank = jnp.take_along_axis(cnt, rack_sorted[:, None], axis=1)[:, 0] - 1
+    # Secondary key keeps global score order within equal ranks.
+    stratified = order[jnp.argsort(rank * bn + jnp.arange(bn, dtype=jnp.int32))]
+    return stratified[:d]
+
+
 def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
                    score_fn: Callable, self_ok_fn: Callable,
                    dst_mask_fn: Optional[Callable] = None,
-                   jitter_frac: float = 1.0):
+                   jitter_frac: float = 1.0,
+                   prune_fn: Optional[Callable] = None,
+                   max_dst: int = 0):
     """One conflict-free batched replica-move phase:
-    (gctx, placement, agg) -> (placement, agg, applied)."""
+    (gctx, placement, agg) -> (placement, agg, applied).
+
+    ``prune_fn`` (goal.dst_prune_score) + ``max_dst`` tile the DESTINATION
+    axis: the C×B pair matrices dominate solve cost at north-star scale, and
+    a goal that can rank brokers by attractiveness (band/count headroom)
+    only ever sends load to the best few hundred of them in one round."""
     accept = _chain_accept_replica(priors)
     need_src_cap = _src_sensitive(goal, priors)
     multi_accept = all(getattr(g, "multi_accept_safe", False)
@@ -299,26 +334,40 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         b = state.num_brokers_padded
         c = num_candidates
         r2 = cand[:, None]
-        d2 = jnp.arange(b)[None, :]
+        pscore = (prune_fn(gctx, placement, agg)
+                  if prune_fn is not None and 0 < max_dst < b else None)
+        if pscore is not None:
+            dst_ids = _stratified_top_dst(gctx, pscore, max_dst)
+            d2 = dst_ids[None, :]
+            nd = max_dst
+        else:
+            dst_ids = None
+            d2 = jnp.arange(b)[None, :]
+            nd = b
         ok = accept(gctx, placement, agg, r2, d2)
         ok = ok & self_ok_fn(gctx, placement, agg, r2, d2)
         if dst_mask_fn is not None:
-            ok = ok & dst_mask_fn(gctx, placement, agg)[None, :]
+            m = dst_mask_fn(gctx, placement, agg)
+            ok = ok & (m if dst_ids is None else m[dst_ids])[None, :]
         cost_raw = goal.dst_cost(gctx, placement, agg, r2, d2)
         cost = jnp.where(ok, cost_raw, _INF_COST)
         # Rank matching: the i-th candidate (priority order) gets the i-th
         # cheapest destination — distinct destinations by construction, so a
         # batch fills as many brokers as it has candidates instead of every
         # argmin landing on the single emptiest broker.  Infeasible pairs
-        # fall back to the candidate's own jittered argmin.
-        proxy = jnp.min(cost, axis=0)                        # f32[B]
+        # fall back to the candidate's own jittered argmin.  All indices here
+        # live in the (possibly pruned) tile space; ``dst`` maps back to
+        # broker ids right below.
+        proxy = jnp.min(cost, axis=0)                        # f32[nd]
         ranked = jnp.argsort(proxy).astype(jnp.int32)        # cheap → expensive
-        assign = ranked[jnp.arange(c, dtype=jnp.int32) % b]
+        assign = ranked[jnp.arange(c, dtype=jnp.int32) % nd]
         ok_assign = jnp.take_along_axis(ok, assign[:, None], axis=1)[:, 0]
         jcost = jnp.where(ok, _jittered(cost_raw, ok, cand, d2, ridx,
                                         frac=jitter_frac), _INF_COST)
         fallback = jnp.argmin(jcost, axis=1).astype(jnp.int32)
         dst = jnp.where(ok_assign, assign, fallback)
+        if dst_ids is not None:
+            dst = dst_ids[dst]
         feasible = jnp.any(ok, axis=1) & is_cand
 
         # Conflict-free batch, candidate-priority order.
@@ -790,10 +839,16 @@ class GoalSolver:
                  max_swap_candidates: int = 1024,
                  mesh=None,
                  dst_jitter_frac: float = 1.0,
-                 stall_limit: int = 8):
+                 stall_limit: int = 8,
+                 # Destination-axis tile for goals declaring dst_prune_score:
+                 # the C×B pair matrices dominate solve cost once B is in the
+                 # thousands, and band/count goals only ever send load to the
+                 # top few hundred headroom brokers in one round.  0 disables.
+                 max_dst_candidates: int = 1024):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self.max_swap_candidates = max_swap_candidates
+        self.max_dst_candidates = max_dst_candidates
         # Soft-goal churn cutoff: stop a goal's while_loop after this many
         # consecutive rounds with neither a violation-count drop nor a
         # relative stats-metric improvement (>1e-4).
@@ -820,8 +875,27 @@ class GoalSolver:
         return jax.device_put((gctx, placement), shardings)
 
     def _width(self, goal: Goal, num_replicas_padded: int) -> int:
-        hint = getattr(goal, "candidate_width_hint", None) or self.max_candidates
-        return min(self.max_candidates, hint, num_replicas_padded)
+        # Narrowing hints (band-bounded goals) always win: scoring past the
+        # band is wasted work.  WIDENING hints (rack) are honored only when
+        # this goal's destination axis is actually tiled — the wide tile is
+        # affordable only because the other axis shrank — and are bounded so
+        # the pair-tile area stays within what the configured cap already
+        # implies (cap² as the affordability proxy for cap×B); with pruning
+        # disabled or a deliberately small operator cap, the hint never
+        # exceeds the cap, so a memory-guard config keeps guarding.
+        cap = self.max_candidates
+        hint = getattr(goal, "candidate_width_hint", None)
+        if hint is None:
+            return min(cap, num_replicas_padded)
+        if hint > cap:
+            prunes = (self.max_dst_candidates > 0
+                      and type(goal).dst_prune_score
+                      is not Goal.dst_prune_score)
+            if not prunes:
+                hint = cap
+            else:
+                hint = min(hint, cap * max(1, cap // self.max_dst_candidates))
+        return min(hint, num_replicas_padded)
 
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
         phases = []
@@ -839,8 +913,12 @@ class GoalSolver:
         if goal.uses_replica_moves:
             phases.append(_replica_phase(goal, priors, c,
                                          goal.candidate_score, goal.self_ok,
-                                         jitter_frac=self.dst_jitter_frac))
+                                         jitter_frac=self.dst_jitter_frac,
+                                         prune_fn=goal.dst_prune_score,
+                                         max_dst=self.max_dst_candidates))
         if goal.has_pull_phase:
+            # The pull phase's destinations are the violated (under-band)
+            # brokers themselves — already masked; pruning adds nothing.
             phases.append(_replica_phase(goal, priors, c,
                                          goal.pull_candidate_score, goal.self_ok,
                                          dst_mask_fn=goal.pull_dst_mask,
@@ -934,8 +1012,11 @@ class GoalSolver:
         # its stats metric is just churning — cut the tail.
         use_stall_cutoff = not goal.is_hard
 
-        def solve(gctx: GoalContext, placement: Placement):
-            agg0 = compute_aggregates(gctx, placement)
+        def solve(gctx: GoalContext, placement: Placement, agg0: Aggregates):
+            # agg0 is caller-supplied: between goals the placement does not
+            # change, so goal N's fresh final recompute IS goal N+1's exact
+            # starting aggregates — threading it saves one O(R) segment-sum
+            # pass per goal in the stack.
             violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
                                 .astype(jnp.int32))
             stranded0 = jnp.sum(currently_offline(gctx, placement)
@@ -982,20 +1063,29 @@ class GoalSolver:
             init = (placement, agg0, jnp.int32(0), jnp.int32(1), jnp.int32(0),
                     violated0, stranded0, metric0,
                     violated0 + stranded0, metric0, jnp.int32(0))
-            pl, _, rounds, _, moves, *_ = \
+            pl, agg_c, rounds, _, moves, *_ = \
                 jax.lax.while_loop(cond, body, init)
             # The RETURNED residuals are computed from one fresh recompute:
             # the in-loop values ride the carried aggregates (exact up to
             # float scatter-drift between resyncs — fine for driving the
             # loop, not for the hard-goal verdict / stats-comparator checks
-            # the caller runs on these numbers).
-            agg_f = compute_aggregates(gctx, pl)
-            violated_f = jnp.sum(goal.violated_brokers(gctx, pl, agg_f)
-                                 .astype(jnp.int32))
-            stranded_f = jnp.sum(currently_offline(gctx, pl)
-                                 .astype(jnp.int32))
-            metric_f = goal.stats_metric(gctx, pl, agg_f)
-            return (pl, rounds, moves, violated_f, stranded_f, metric_f,
+            # the caller runs on these numbers).  Zero-round solves (already-
+            # satisfied goals) skip the O(R) recompute: nothing moved, so the
+            # entry aggregates and residuals are still exact — this keeps a
+            # satisfied goal's solve at O(B) instead of O(R).
+            def _fresh(pl):
+                agg_f = compute_aggregates(gctx, pl)
+                violated_f = jnp.sum(goal.violated_brokers(gctx, pl, agg_f)
+                                     .astype(jnp.int32))
+                stranded_f = jnp.sum(currently_offline(gctx, pl)
+                                     .astype(jnp.int32))
+                metric_f = goal.stats_metric(gctx, pl, agg_f)
+                return agg_f, violated_f, stranded_f, metric_f
+
+            agg_f, violated_f, stranded_f, metric_f = jax.lax.cond(
+                rounds > 0, _fresh,
+                lambda pl: (agg_c, violated0, stranded0, metric0), pl)
+            return (pl, agg_f, rounds, moves, violated_f, stranded_f, metric_f,
                     violated0, metric0)
 
         return solve
@@ -1029,30 +1119,73 @@ class GoalSolver:
                     state=state, host_capacity=host_cap,
                     excluded_for_replica_move=excl_move,
                     excluded_for_leadership=excl_lead)
-                return solve_body(g2, placement)
+                out = solve_body(g2, placement,
+                                 compute_aggregates(g2, placement))
+                # Drop the final aggregates from the vmapped outputs: a
+                # [scenarios, topics, brokers] leader-count stack is hundreds
+                # of MB at north-star scale and no lane consumer wants it.
+                return (out[0],) + out[2:]
             return jax.vmap(one)(alive_s, excl_move_s, excl_lead_s, placement_s)
 
         self._round_cache[key] = batch
         return batch
 
     def optimize_goal(self, goal: Goal, priors: Sequence[Goal], gctx: GoalContext,
-                      placement: Placement) -> Tuple[Placement, GoalOptimizationInfo]:
+                      placement: Placement, agg: Optional[Aggregates] = None,
+                      ) -> Tuple[Placement, Aggregates, GoalOptimizationInfo]:
         """Run rounds until converged (the reference's per-goal
         ``while !finished`` loop, GoalOptimizer.java:437-462) — one device
-        dispatch and one host sync per goal."""
+        dispatch and one host sync per goal.
+
+        ``agg`` lets the caller thread one goal's exact final aggregates into
+        the next goal's solve (the placement is unchanged in between); the
+        returned aggregates are always a fresh full recompute."""
         solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
-        placement, rounds, moves, violated, stranded, metric, violated0, metric0 = \
-            solve(gctx, placement)
+        if agg is None:
+            agg = self.aggregates(gctx, placement)
+        (placement, agg, rounds, moves, violated, stranded, metric, violated0,
+         metric0) = solve(gctx, placement, agg)
         info = GoalOptimizationInfo(
             goal_name=goal.name,
             rounds=int(rounds),
             moves_applied=int(moves),
             violated_brokers_before=int(violated0),
             violated_brokers_after=int(violated),
+            stranded_after=int(stranded),
             metric_before=float(metric0),
             metric_after=float(metric) if int(rounds) > 0 else float(metric0),
         )
-        return placement, info
+        return placement, agg, info
+
+    def aggregates(self, gctx: GoalContext, placement: Placement) -> Aggregates:
+        """Jitted full-aggregate recompute for host-side callers (the eager
+        path runs the same segment-sums unfused — measurably slower at 1M
+        replicas)."""
+        if "aggregates" not in self._round_cache:
+            self._round_cache["aggregates"] = jax.jit(compute_aggregates)
+        return self._round_cache["aggregates"](gctx, placement)
+
+    def violations(self, goals: Sequence[Goal], gctx: GoalContext,
+                   placement: Placement, agg: Aggregates):
+        """Per-goal violated-broker counts as ONE jitted dispatch (i32[G]).
+
+        The optimizer needs the full stack's violation vector before and
+        after a run (`violated_before`/`violated_after`, and the polish
+        pass's re-violation scan); fusing the G checks avoids G eager
+        multi-kernel passes over replica-sized arrays."""
+        if not goals:
+            return np.zeros(0, dtype=np.int32)
+        key = ("violations", tuple(g.key() for g in goals))
+        if key not in self._round_cache:
+            gs = tuple(goals)
+
+            def fn(gctx, placement, agg):
+                return jnp.stack([
+                    jnp.sum(g.violated_brokers(gctx, placement, agg)
+                            .astype(jnp.int32)) for g in gs])
+
+            self._round_cache[key] = jax.jit(fn)
+        return np.asarray(self._round_cache[key](gctx, placement, agg))
 
 
 _DEFAULT_SOLVER: Optional["GoalSolver"] = None

@@ -73,9 +73,10 @@ def main() -> None:
         total0 = time.monotonic()
         priors = []
         rows = []
+        agg = None
         for goal in goals:
             t0 = time.monotonic()
-            pl, info = solver.optimize_goal(goal, priors, gctx, pl)
+            pl, agg, info = solver.optimize_goal(goal, priors, gctx, pl, agg)
             jax.block_until_ready(pl.broker)
             dt = time.monotonic() - t0
             print(f"  {goal.name:44s} {dt*1000:9.1f} ms rounds={info.rounds:3d} "

@@ -378,3 +378,37 @@ def test_batch_appliers_match_recompute():
     for got, want in zip(jtu.tree_leaves(agg3), jtu.tree_leaves(fresh3)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-3)
+
+
+def test_dst_pruned_tiles_match_full_scan_quality():
+    """Destination tiling (dst_prune_score + max_dst_candidates) at a broker
+    count ABOVE the tile width must still satisfy every goal, including the
+    rack goals whose tile is widened past the candidate cap only because the
+    dst axis shrank.  The stratified selection guarantees every rack keeps
+    slots, so hard rack feasibility must be unaffected; quality must match
+    the full-B scan's violation outcome (zero) on the same snapshot."""
+    from cruise_control_tpu.analyzer.solver import GoalSolver
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(num_brokers=48, num_racks=6, num_topics=24,
+                                 num_replicas=900, mean_cpu=0.004,
+                                 mean_disk=80.0, mean_nw_in=80.0,
+                                 mean_nw_out=80.0, seed=77)
+    state, placement, meta = rc.generate(props)
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+             "CpuCapacityGoal", "ReplicaDistributionGoal",
+             "NetworkInboundUsageDistributionGoal",
+             "CpuUsageDistributionGoal", "LeaderReplicaDistributionGoal"]
+    pruned = GoalOptimizer(goal_names=goals,
+                           solver=GoalSolver(max_dst_candidates=16))
+    r_pruned = pruned.optimizations(state, placement, meta)
+    assert r_pruned.violated_goals_after == [], r_pruned.violated_goals_after
+
+    full = GoalOptimizer(goal_names=goals,
+                         solver=GoalSolver(max_dst_candidates=0))
+    r_full = full.optimizations(state, placement, meta)
+    assert r_full.violated_goals_after == []
+    # The pruned run must not need wildly more work than the full scan.
+    rounds_p = sum(g.rounds for g in r_pruned.goal_infos)
+    rounds_f = sum(g.rounds for g in r_full.goal_infos)
+    assert rounds_p <= 3 * max(rounds_f, 1), (rounds_p, rounds_f)
