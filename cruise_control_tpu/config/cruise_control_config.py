@@ -138,6 +138,12 @@ def _webserver_def() -> ConfigDef:
     d.define("webserver.http.port", ConfigType.INT, 9090)
     d.define("webserver.http.address", ConfigType.STRING, "127.0.0.1")
     d.define("webserver.api.urlprefix", ConfigType.STRING, "/kafkacruisecontrol/*")
+    # Static frontend (reference WebServerConfig:81-90 + setupWebUi): empty
+    # diskpath disables serving (the frontend bundle ships separately).
+    d.define("webserver.ui.diskpath", ConfigType.STRING, "",
+             doc="directory with the built web frontend; empty = no UI")
+    d.define("webserver.ui.urlprefix", ConfigType.STRING, "/*",
+             doc="URL path the frontend is served from")
     d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000)
     d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 21_600_000)
     # Security (reference WebServerConfig.WEBSERVER_SECURITY_*):

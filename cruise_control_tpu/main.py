@@ -182,7 +182,9 @@ def build_app(config: CruiseControlConfig,
         security=_security_provider(config),
         ssl_certfile=config["webserver.ssl.certfile"] if ssl_on else None,
         ssl_keyfile=config["webserver.ssl.keyfile"] or None,
-        ssl_keyfile_password=config["webserver.ssl.keyfile.password"] or None)
+        ssl_keyfile_password=config["webserver.ssl.keyfile.password"] or None,
+        ui_diskpath=config["webserver.ui.diskpath"] or None,
+        ui_urlprefix=config["webserver.ui.urlprefix"])
     return app
 
 

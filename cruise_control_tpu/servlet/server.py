@@ -131,10 +131,17 @@ class CruiseControlApp:
                  security=None,
                  ssl_certfile: Optional[str] = None,
                  ssl_keyfile: Optional[str] = None,
-                 ssl_keyfile_password: Optional[str] = None):
+                 ssl_keyfile_password: Optional[str] = None,
+                 ui_diskpath: Optional[str] = None,
+                 ui_urlprefix: str = "/*"):
         self.cc = cc
         self.user_tasks = UserTaskManager(max_active_tasks=max_active_user_tasks)
         self.purgatory = Purgatory() if two_step_verification else None
+        # Static frontend serving (KafkaCruiseControlApp.setupWebUi + Jetty
+        # DefaultServlet; WebServerConfig webserver.ui.diskpath/.urlprefix):
+        # GETs outside the API prefix serve files from ``ui_diskpath``.
+        self.ui_diskpath = ui_diskpath
+        self.ui_urlprefix = ui_urlprefix
         # Optional servlet security provider (servlet/security.py): when set,
         # every request is authenticated and role-checked before dispatch.
         self.security = security
@@ -433,7 +440,19 @@ def _make_handler(app: CruiseControlApp):
         def _dispatch(self, method: str):
             parsed = urllib.parse.urlparse(self.path)
             if not parsed.path.startswith(URL_PREFIX):
-                self._send(404, {"error": "not found"})
+                # The API prefix always wins; anything else is the static
+                # frontend when one is configured (Jetty DefaultServlet
+                # semantics: GET only, index.html for the root).  The
+                # security handler covers the UI exactly as it covers the
+                # API (the reference mounts both in one secured context):
+                # any authenticated principal may fetch frontend assets.
+                if method == "GET" and app.ui_diskpath:
+                    if app.security is not None \
+                            and self._authenticate_or_401() is None:
+                        return
+                    self._serve_ui(parsed.path)
+                else:
+                    self._send(404, {"error": "not found"})
                 return
             endpoint = parsed.path[len(URL_PREFIX):].strip("/").lower()
             if app.security is not None:
@@ -441,16 +460,8 @@ def _make_handler(app: CruiseControlApp):
                     permits,
                     required_role,
                 )
-                try:
-                    principal = app.security.authenticate(
-                        dict(self.headers), self.client_address[0])
-                except Exception:   # noqa: BLE001 — provider bug reads as 401
-                    LOG.exception("security provider failed")
-                    principal = None
+                principal = self._authenticate_or_401()
                 if principal is None:
-                    self._send(401, {"error": "authentication required",
-                                     "version": 1},
-                               app.security.challenge())
                     return
                 need = required_role(method, endpoint)
                 if not permits(principal.role, need):
@@ -480,12 +491,67 @@ def _make_handler(app: CruiseControlApp):
                     "error": type(e).__name__, "message": str(e)}, {}
             if isinstance(payload, dict):
                 payload.setdefault("version", 1)
-            # SPNEGO mutual auth: the provider may carry a GSS reply token
-            # for this thread's successful exchange (RFC 4559 §4.2).
-            mutual = getattr(app.security, "mutual_auth_header", None)
-            if mutual is not None:
-                headers = {**(headers or {}), **mutual()}
+            headers = {**(headers or {}), **self._mutual_auth_headers()}
             self._send(status, payload, headers)
+
+        def _authenticate_or_401(self):
+            """Shared auth gate for API and UI requests: returns the
+            Principal, or sends the 401 challenge and returns None."""
+            try:
+                principal = app.security.authenticate(
+                    dict(self.headers), self.client_address[0])
+            except Exception:   # noqa: BLE001 — provider bug reads as 401
+                LOG.exception("security provider failed")
+                principal = None
+            if principal is None:
+                self._send(401, {"error": "authentication required",
+                                 "version": 1},
+                           app.security.challenge())
+            return principal
+
+        def _mutual_auth_headers(self) -> Dict[str, str]:
+            """SPNEGO mutual auth: the provider may carry a GSS reply token
+            for this thread's successful exchange (RFC 4559 §4.2); every
+            authenticated response — API or UI asset — must return it."""
+            mutual = getattr(app.security, "mutual_auth_header", None)
+            return mutual() if mutual is not None else {}
+
+        def _serve_ui(self, raw_path: str):
+            import mimetypes
+            import os
+            # Everything filesystem-touching sits inside one guard: a
+            # null-byte path (realpath raises ValueError), an unreadable
+            # file, or a delete between the isfile check and open() must
+            # surface as an HTTP 404, not a dropped connection.
+            try:
+                prefix = app.ui_urlprefix.rstrip("*").rstrip("/")  # "/*" → ""
+                path = urllib.parse.unquote(raw_path)
+                if prefix and not (path == prefix
+                                   or path.startswith(prefix + "/")):
+                    self._send(404, {"error": "not found"})
+                    return
+                rel = path[len(prefix):].lstrip("/") or "index.html"
+                root = os.path.realpath(app.ui_diskpath)
+                full = os.path.realpath(os.path.join(root, rel))
+                # realpath + prefix check: symlinks and ../ cannot escape
+                # the configured frontend directory.
+                inside = full == root or full.startswith(root + os.sep)
+                if not inside or not os.path.isfile(full):
+                    self._send(404, {"error": "not found"})
+                    return
+                with open(full, "rb") as f:
+                    body = f.read()
+            except (OSError, ValueError):
+                self._send(404, {"error": "not found"})
+                return
+            ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in self._mutual_auth_headers().items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
 
         def _send(self, status: int, payload: Dict,
                   headers: Optional[Dict[str, str]] = None):

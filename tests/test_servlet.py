@@ -285,3 +285,128 @@ def test_rebalance_disk_uses_intra_broker_goals(app):
     status, body, _ = _post(app, "rebalance", dryrun="true",
                             rebalance_disk="true", kafka_assigner="true")
     assert status == 400
+
+
+def test_static_ui_serving(tmp_path):
+    """webserver.ui.diskpath serving (KafkaCruiseControlApp.setupWebUi /
+    Jetty DefaultServlet): index.html at the prefix root, content-type by
+    extension, API prefix untouched, and no path escape from the UI dir."""
+    ui = tmp_path / "dist"
+    ui.mkdir()
+    (ui / "index.html").write_text("<html>cc-ui</html>")
+    (ui / "app.js").write_text("console.log(1)")
+    secret = tmp_path / "secret.txt"
+    secret.write_text("keep out")
+
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    app = CruiseControlApp(cc, port=0, ui_diskpath=str(ui))
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        with urllib.request.urlopen(f"{base}/") as r:
+            assert r.status == 200
+            assert b"cc-ui" in r.read()
+            assert "text/html" in r.headers["Content-Type"]
+        with urllib.request.urlopen(f"{base}/app.js") as r:
+            assert "javascript" in r.headers["Content-Type"]
+        # API prefix still wins over the frontend.
+        with urllib.request.urlopen(
+                f"{base}/kafkacruisecontrol/state") as r:
+            assert r.status == 200
+        for bad in ("/../secret.txt", "/%2e%2e/secret.txt", "/missing.html",
+                    "/%00", "/a%00b.html"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + bad)
+            assert ei.value.code == 404, bad
+    finally:
+        app.stop()
+
+
+def test_static_ui_requires_auth_when_security_enabled(tmp_path):
+    """With a security provider configured, frontend assets are covered by
+    the same authentication as the API (the reference secures the whole
+    Jetty context the DefaultServlet is mounted in)."""
+    import base64
+
+    from cruise_control_tpu.servlet.security import BasicSecurityProvider, Role
+
+    ui = tmp_path / "dist"
+    ui.mkdir()
+    (ui / "index.html").write_text("<html>cc-ui</html>")
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    sec = BasicSecurityProvider(users={"bob": ("pw", Role.VIEWER)})
+    app = CruiseControlApp(cc, port=0, ui_diskpath=str(ui), security=sec)
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/")
+        assert ei.value.code == 401
+        req = urllib.request.Request(f"{base}/", headers={
+            "Authorization": "Basic "
+            + base64.b64encode(b"bob:pw").decode()})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200 and b"cc-ui" in r.read()
+    finally:
+        app.stop()
+
+
+def test_static_ui_custom_urlprefix(tmp_path):
+    """Non-default webserver.ui.urlprefix: the bare prefix and files under
+    it serve; near-miss paths and the root 404."""
+    ui = tmp_path / "dist"
+    ui.mkdir()
+    (ui / "index.html").write_text("<html>cc-ui</html>")
+    (ui / "app.js").write_text("console.log(1)")
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    app = CruiseControlApp(cc, port=0, ui_diskpath=str(ui),
+                           ui_urlprefix="/ui/*")
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        with urllib.request.urlopen(f"{base}/ui") as r:
+            assert b"cc-ui" in r.read()
+        with urllib.request.urlopen(f"{base}/ui/app.js") as r:
+            assert b"console" in r.read()
+        for bad in ("/", "/uix", "/uifoo/app.js"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + bad)
+            assert ei.value.code == 404, bad
+    finally:
+        app.stop()
+
+
+def test_static_ui_carries_spnego_mutual_auth_token(tmp_path):
+    """A mutual-auth GSS reply token must ride UI asset responses exactly as
+    it rides API responses (RFC 4559 §4.2)."""
+    import base64
+
+    from cruise_control_tpu.servlet.security import (
+        Role,
+        SpnegoSecurityProvider,
+    )
+
+    ui = tmp_path / "dist"
+    ui.mkdir()
+    (ui / "index.html").write_text("<html>cc-ui</html>")
+
+    def validator(token: bytes):
+        assert token == b"ticket"
+        return "carol@EXAMPLE.COM", b"server-reply"
+
+    sec = SpnegoSecurityProvider(validator,
+                                 roles_by_user={"carol": Role.ADMIN})
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    app = CruiseControlApp(cc, port=0, ui_diskpath=str(ui), security=sec)
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        tok = base64.b64encode(b"ticket").decode()
+        req = urllib.request.Request(
+            f"{base}/", headers={"Authorization": "Negotiate " + tok})
+        with urllib.request.urlopen(req) as r:
+            assert b"cc-ui" in r.read()
+            reply = r.headers["WWW-Authenticate"]
+        assert reply == "Negotiate " + base64.b64encode(b"server-reply").decode()
+    finally:
+        app.stop()
