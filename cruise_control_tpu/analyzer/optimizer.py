@@ -46,6 +46,29 @@ _BALANCEDNESS_WEIGHT_HARD = 3.0
 _BALANCEDNESS_WEIGHT_SOFT = 1.0
 
 
+def _host_local_placement(placement: Placement) -> Placement:
+    """Placement with every leaf addressable on THIS process.
+
+    Identity unless a leaf is actually a cross-process sharded global array
+    (a GoalOptimizer built WITHOUT the global mesh keeps host-local arrays
+    even inside a jax.distributed program — facade/detector optimizers must
+    stay collective-free there, or non-lockstep calls would deadlock).  For
+    global-mesh outputs (parallel/multihost.py) the host-side consumers
+    (stats jit, proposal diff) need full arrays — gather them; every
+    process reconstructs the same global value."""
+    import jax
+
+    non_addressable = any(
+        isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+        for leaf in jax.tree_util.tree_leaves(placement))
+    if not non_addressable:
+        return placement
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(placement, tiled=True)
+    return jax.tree_util.tree_map(np.asarray, gathered)
+
+
 @dataclass
 class OptimizerResult:
     """Reference: ``analyzer/OptimizerResult.java``."""
@@ -211,7 +234,9 @@ class GoalOptimizer:
         agg0 = self.solver.aggregates(gctx, placement)
         vio0 = self.solver.violations(goals, gctx, placement, agg0)
         violated_before = [g.name for g, v in zip(goals, vio0) if v > 0]
-        stats_before = compute_stats(state, placement, self.constraint.balance_threshold)
+        initial_local = _host_local_placement(placement)
+        stats_before = compute_stats(state, initial_local,
+                                     self.constraint.balance_threshold)
 
         # AbstractGoal.java:108-117: the stats-must-not-worsen contract is
         # waived only when the cluster has broken brokers or excluded-for-move
@@ -296,8 +321,10 @@ class GoalOptimizer:
         # the placement has not changed since the last one.
         vioN = self.solver.violations(goals, gctx, placement, agg)
         violated_after = [g.name for g, v in zip(goals, vioN) if v > 0]
-        stats_after = compute_stats(state, placement, self.constraint.balance_threshold)
-        proposals = diff_proposals(state, initial, placement, meta)
+        final_local = _host_local_placement(placement)
+        stats_after = compute_stats(state, final_local,
+                                    self.constraint.balance_threshold)
+        proposals = diff_proposals(state, initial_local, final_local, meta)
 
         result = OptimizerResult(
             proposals=proposals,
@@ -308,7 +335,7 @@ class GoalOptimizer:
             violated_goals_after=violated_after,
             balancedness_score=balancedness_score(infos, goals),
             elapsed_s=time.monotonic() - t0,
-            final_placement=placement,
+            final_placement=final_local,
         )
         proposal_timer.update_ms(result.elapsed_s * 1000.0)
         registry().settable_gauge("AnomalyDetector.balancedness-score").set(

@@ -1,0 +1,88 @@
+"""Multi-host (multi-process) solver execution.
+
+SURVEY §5 "Distributed communication backend": the reference's control
+plane speaks Kafka/ZK and scales its solver by threads inside one JVM.
+The TPU-native scale-out axis is a *global* ``jax.sharding.Mesh`` spanning
+every process of a multi-host deployment: JAX's distributed runtime (gRPC
+coordinator — the DCN control channel) assembles all processes' chips into
+one mesh, the solver's replica-axis shardings (``parallel/mesh.py``) apply
+unchanged, and XLA inserts the cross-host collectives (psum/all-gather)
+that ride ICI within a slice and DCN across slices.
+
+Deployment contract (standard SPMD):
+
+- every process runs the same program and calls :func:`propose_multihost`
+  with a snapshot of the SAME padded shapes AND the same ``meta``
+  (topic/broker identities are resolved process-locally when proposals are
+  assembled, so meta must be identical everywhere — it is names and ids,
+  not load data, and is not broadcast);
+- the COORDINATOR's tensor content wins — (state, placement) arrays are
+  broadcast from process 0 before the solve, so workers may pass
+  placeholder array content (zeros of the agreed size class);
+- every process receives the identical :class:`OptimizerResult` (the solve
+  itself is deterministic, and host-side assembly runs on process-local
+  copies gathered from the global mesh).
+
+Verified end-to-end by ``tests/test_multihost.py``, which spawns two
+coordinated processes on a virtual-CPU mesh and asserts both emit
+identical proposals.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from cruise_control_tpu.parallel.mesh import make_solver_mesh
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """Join this process to the distributed runtime.  A repeat call with a
+    runtime already up is a no-op (callers may share one bootstrap path);
+    ``coordinator_address`` is ``host:port`` of process 0 — reachable over
+    the deployment's control network (DCN)."""
+    try:
+        from jax._src.distributed import global_state as _state
+    except ImportError:         # private module moved: fall back to raising
+        _state = None           # on double-init like raw jax.distributed
+    if _state is not None and getattr(_state, "client", None) is not None:
+        return
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_solver_mesh(scenario_parallelism: int = 1):
+    """Solver mesh over EVERY process's devices (call after
+    :func:`initialize`; single-process it equals the local mesh)."""
+    return make_solver_mesh(scenario_parallelism=scenario_parallelism)
+
+
+def broadcast_from_coordinator(tree):
+    """Overwrite every process's copy of ``tree`` with process 0's content
+    (shapes/dtypes must already agree — the SPMD contract above)."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def propose_multihost(state, placement, meta, goal_names: Optional[Sequence[str]] = None,
+                      constraint=None, scenario_parallelism: int = 1,
+                      polish_passes: int = 1):
+    """Run one full proposal generation on the global mesh.
+
+    All processes must call this with same-shaped (state, placement) and an
+    IDENTICAL meta (see the module contract); process 0's array content is
+    broadcast, the goal stack solves sharded over the global replica axis,
+    and the identical OptimizerResult is returned everywhere.
+    """
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    state, placement = broadcast_from_coordinator((state, placement))
+    mesh = global_solver_mesh(scenario_parallelism)
+    opt = GoalOptimizer(constraint=constraint, goal_names=goal_names,
+                        mesh=mesh, polish_passes=polish_passes)
+    return opt.optimizations(state, placement, meta)

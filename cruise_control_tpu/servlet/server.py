@@ -523,12 +523,15 @@ def _make_handler(app: CruiseControlApp):
             # null-byte path (realpath raises ValueError), an unreadable
             # file, or a delete between the isfile check and open() must
             # surface as an HTTP 404, not a dropped connection.
+            # Every response of an authenticated exchange carries the
+            # mutual-auth reply token, 404s included (RFC 4559 §4.2).
+            mutual = self._mutual_auth_headers()
             try:
                 prefix = app.ui_urlprefix.rstrip("*").rstrip("/")  # "/*" → ""
                 path = urllib.parse.unquote(raw_path)
                 if prefix and not (path == prefix
                                    or path.startswith(prefix + "/")):
-                    self._send(404, {"error": "not found"})
+                    self._send(404, {"error": "not found"}, mutual)
                     return
                 rel = path[len(prefix):].lstrip("/") or "index.html"
                 root = os.path.realpath(app.ui_diskpath)
@@ -537,18 +540,18 @@ def _make_handler(app: CruiseControlApp):
                 # the configured frontend directory.
                 inside = full == root or full.startswith(root + os.sep)
                 if not inside or not os.path.isfile(full):
-                    self._send(404, {"error": "not found"})
+                    self._send(404, {"error": "not found"}, mutual)
                     return
                 with open(full, "rb") as f:
                     body = f.read()
             except (OSError, ValueError):
-                self._send(404, {"error": "not found"})
+                self._send(404, {"error": "not found"}, mutual)
                 return
             ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
-            for k, v in self._mutual_auth_headers().items():
+            for k, v in mutual.items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
