@@ -229,7 +229,6 @@ class GoalOptimizer:
         proposal_timer = registry().timer("GoalOptimizer.proposal-computation-timer")
         gctx = build_context(state, placement, meta, self.constraint, options)
         gctx, placement = self.solver.shard_inputs(gctx, placement)
-        initial = placement
 
         agg0 = self.solver.aggregates(gctx, placement)
         vio0 = self.solver.violations(goals, gctx, placement, agg0)

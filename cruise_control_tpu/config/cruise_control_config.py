@@ -74,7 +74,10 @@ def _analyzer_def() -> ConfigDef:
     d.define("proposal.expiration.ms", ConfigType.LONG, 60_000)
     d.define("goal.violation.distribution.threshold.multiplier",
              ConfigType.DOUBLE, 1.0)
-    d.define("num.proposal.precompute.threads", ConfigType.INT, 1)
+    d.define("num.proposal.precompute.threads", ConfigType.INT, 1,
+             doc="accepted for reference compatibility; the batched solver "
+                 "precomputes with one daemon (a solve is one device "
+                 "dispatch, so a thread pool adds nothing)")
     return d
 
 
@@ -86,7 +89,10 @@ def _monitor_def() -> ConfigDef:
     d.define("broker.metrics.window.ms", ConfigType.LONG, 300_000)
     d.define("min.samples.per.partition.metrics.window", ConfigType.INT, 1)
     d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000)
-    d.define("monitor.state.update.interval.ms", ConfigType.LONG, 30_000)
+    d.define("monitor.state.update.interval.ms", ConfigType.LONG, 30_000,
+             doc="accepted for reference compatibility; monitor state here "
+                 "is computed on read (with a short-lived cache), not on a "
+                 "refresh timer")
     d.define("broker.capacity.config.resolver.class", ConfigType.CLASS, "")
     d.define("capacity.config.file", ConfigType.STRING, "")
     d.define("sample.store.class", ConfigType.CLASS, "")
@@ -144,8 +150,13 @@ def _webserver_def() -> ConfigDef:
              doc="directory with the built web frontend; empty = no UI")
     d.define("webserver.ui.urlprefix", ConfigType.STRING, "/*",
              doc="URL path the frontend is served from")
-    d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000)
-    d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 21_600_000)
+    d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000,
+             doc="accepted for reference compatibility; every mutating "
+                 "request is async-202 from the start, so there is no "
+                 "sync-to-async conversion timer")
+    d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 21_600_000,
+             doc="accepted for reference compatibility; task affinity rides "
+                 "the User-Task-ID header, not servlet sessions")
     # Security (reference WebServerConfig.WEBSERVER_SECURITY_*):
     d.define("webserver.security.enable", ConfigType.BOOLEAN, False)
     # "basic" | "jwt" | "trusted_proxy"

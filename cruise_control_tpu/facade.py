@@ -91,10 +91,15 @@ class CruiseControl:
         self_healing_goals: Optional[Sequence[str]] = None,
         anomaly_detection_interval_s: float = 300.0,
         proposal_precompute_interval_s: float = 0.0,
+        default_completeness: Optional[ModelCompletenessRequirements] = None,
+        topic_anomaly_target_rf: Optional[int] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
         self.task_runner = task_runner
+        # Baseline completeness gate for every goal-based operation
+        # (min.valid.partition.ratio; requests may pass stricter ones).
+        self.default_completeness = default_completeness
         self.constraint = constraint or BalancingConstraint()
         self.default_goals = list(default_goals or DEFAULT_GOALS)
         self.optimizer = GoalOptimizer(constraint=self.constraint,
@@ -105,6 +110,7 @@ class CruiseControl:
             executor.set_sampling_hooks(
                 lambda: task_runner.pause_sampling("executor"),
                 lambda: task_runner.resume_sampling("executor"))
+        self.topic_anomaly_target_rf = topic_anomaly_target_rf
         self.anomaly_detector = self._build_anomaly_detector(
             self_healing_goals, anomaly_detection_interval_s)
         # Background proposal precompute (GoalOptimizer.java:137-188): a
@@ -172,7 +178,8 @@ class CruiseControl:
                 if generation == self._precomputed_generation:
                     continue
                 if not self.load_monitor.meet_completeness_requirements(
-                        ModelCompletenessRequirements()):
+                        self.default_completeness
+                        or ModelCompletenessRequirements()):
                     continue
                 self.proposals()
                 self._precomputed_generation = generation
@@ -190,7 +197,8 @@ class CruiseControl:
             AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(
                 self.load_monitor.broker_aggregator),
             AnomalyType.TOPIC_ANOMALY: TopicAnomalyDetector(
-                self.load_monitor.metadata_client),
+                self.load_monitor.metadata_client,
+                target_replication_factor=self.topic_anomaly_target_rf),
             AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(),
         }
         return AnomalyDetectorManager(
@@ -243,6 +251,8 @@ class CruiseControl:
         use_cached: bool = False,
     ) -> OperationResult:
         goals = list(goals or self.default_goals)
+        if requirements is None:
+            requirements = self.default_completeness
         if not dryrun:
             self.executor.set_generating_proposals_for_execution(True)
         try:

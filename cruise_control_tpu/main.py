@@ -74,6 +74,8 @@ def build_app(config: CruiseControlConfig,
         num_windows=config["num.partition.metrics.windows"],
         window_ms=config["partition.metrics.window.ms"],
         min_samples_per_window=config["min.samples.per.partition.metrics.window"],
+        num_broker_windows=config["num.broker.metrics.windows"],
+        broker_window_ms=config["broker.metrics.window.ms"],
     )
     store_dir = config.get("sample.store.dir")
     mode = config.get("metric.sampler.mode", "synthetic")
@@ -130,6 +132,23 @@ def build_app(config: CruiseControlConfig,
             endpoint=config["prometheus.server.endpoint"])
     else:
         sampler = SyntheticWorkloadSampler()
+    # Reflective plugin overrides (AbstractConfig.getConfiguredInstance):
+    # an explicit *.class key beats the mode-derived default.  Like the
+    # reference, the plugin receives the config (via a ``config=`` ctor
+    # kwarg); plugins without one are constructed bare.
+    def _plugin(path, **kwargs):
+        from cruise_control_tpu.config.config_def import get_configured_instance
+        try:
+            return get_configured_instance(path, config=config, **kwargs)
+        except TypeError:
+            return get_configured_instance(path, **kwargs)
+
+    sampler_cls = str(config.originals.get("metric.sampler.class", "") or "")
+    if sampler_cls:
+        sampler = _plugin(sampler_cls)
+    store_cls = str(config.originals.get("sample.store.class", "") or "")
+    if store_cls:
+        store = _plugin(store_cls)
     task_runner = LoadMonitorTaskRunner(
         load_monitor, sampler, store,
         sampling_interval_ms=config["metric.sampling.interval.ms"])
@@ -150,6 +169,9 @@ def build_app(config: CruiseControlConfig,
             **notifier_kwargs)
     else:
         notifier = SelfHealingNotifier(**notifier_kwargs)
+    notifier_cls = str(config.originals.get("anomaly.notifier.class", "") or "")
+    if notifier_cls:
+        notifier = _plugin(notifier_cls, **notifier_kwargs)
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,
         constraint=config.balancing_constraint(),
@@ -159,7 +181,12 @@ def build_app(config: CruiseControlConfig,
         anomaly_detection_interval_s=
             config["anomaly.detection.interval.ms"] / 1000.0,
         proposal_precompute_interval_s=
-            config["proposal.expiration.ms"] / 1000.0)
+            config["proposal.expiration.ms"] / 1000.0,
+        default_completeness=_default_completeness(config),
+        topic_anomaly_target_rf=(
+            int(config["topic.anomaly.target.replication.factor"])
+            if config.originals.get("topic.anomaly.target.replication.factor")
+            else None))
     ssl_on = config["webserver.ssl.enable"]
     if ssl_on and not config["webserver.ssl.certfile"]:
         hint = ""
@@ -184,8 +211,24 @@ def build_app(config: CruiseControlConfig,
         ssl_keyfile=config["webserver.ssl.keyfile"] or None,
         ssl_keyfile_password=config["webserver.ssl.keyfile.password"] or None,
         ui_diskpath=config["webserver.ui.diskpath"] or None,
-        ui_urlprefix=config["webserver.ui.urlprefix"])
+        ui_urlprefix=config["webserver.ui.urlprefix"],
+        api_urlprefix=config["webserver.api.urlprefix"],
+        user_task_retention_ms=config["completed.user.task.retention.time.ms"])
     return app
+
+
+def _default_completeness(config):
+    """min.valid.partition.ratio → the baseline completeness gate every
+    goal-based operation must clear (LoadMonitor.meetCompletenessRequirements
+    compares it to the valid-entity ratio)."""
+    ratio = float(config["min.valid.partition.ratio"])
+    if ratio <= 0.0:
+        return None
+    from cruise_control_tpu.monitor.load_monitor import (
+        ModelCompletenessRequirements,
+    )
+    return ModelCompletenessRequirements(
+        min_monitored_partitions_percentage=ratio)
 
 
 def _security_provider(config: CruiseControlConfig):

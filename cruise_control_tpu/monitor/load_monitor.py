@@ -82,6 +82,8 @@ class LoadMonitor:
         window_ms: int = 300_000,
         min_samples_per_window: int = 1,
         max_concurrent_model_generations: int = 2,
+        num_broker_windows: int = 20,
+        broker_window_ms: Optional[int] = None,
     ):
         self.metadata_client = metadata_client
         self.capacity_resolver = capacity_resolver or FixedBrokerCapacityResolver(
@@ -92,7 +94,8 @@ class LoadMonitor:
             min_samples_per_window=min_samples_per_window,
             group_of=lambda e: e[0])     # group = topic
         self.broker_aggregator = MetricSampleAggregator(
-            md.BROKER_METRIC_DEF, num_windows=20, window_ms=window_ms,
+            md.BROKER_METRIC_DEF, num_windows=num_broker_windows,
+            window_ms=broker_window_ms or window_ms,
             min_samples_per_window=min_samples_per_window)
         # Fair semaphore bounding concurrent model generations (:163-166).
         self._model_semaphore = threading.BoundedSemaphore(

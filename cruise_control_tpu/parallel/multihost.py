@@ -45,13 +45,17 @@ def initialize(coordinator_address: str, num_processes: int,
     the deployment's control network (DCN)."""
     try:
         from jax._src.distributed import global_state as _state
-    except ImportError:         # private module moved: fall back to raising
-        _state = None           # on double-init like raw jax.distributed
+    except ImportError:         # private module moved: rely on the
+        _state = None           # message-matched RuntimeError below
     if _state is not None and getattr(_state, "client", None) is not None:
         return
-    jax.distributed.initialize(coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    try:
+        jax.distributed.initialize(coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        if "already initialized" not in str(e).lower():
+            raise
 
 
 def global_solver_mesh(scenario_parallelism: int = 1):

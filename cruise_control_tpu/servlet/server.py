@@ -133,9 +133,19 @@ class CruiseControlApp:
                  ssl_keyfile: Optional[str] = None,
                  ssl_keyfile_password: Optional[str] = None,
                  ui_diskpath: Optional[str] = None,
-                 ui_urlprefix: str = "/*"):
+                 ui_urlprefix: str = "/*",
+                 api_urlprefix: str = "/kafkacruisecontrol/*",
+                 user_task_retention_ms: float = 86_400_000):
         self.cc = cc
-        self.user_tasks = UserTaskManager(max_active_tasks=max_active_user_tasks)
+        self.user_tasks = UserTaskManager(
+            max_active_tasks=max_active_user_tasks,
+            completed_retention_ms=user_task_retention_ms)
+        # webserver.api.urlprefix (WebServerConfig): the mount point of the
+        # REST API, normalized to a trailing-slash prefix for dispatch.  A
+        # root mount ("/*" or "/") is honored — the API then owns every
+        # path and any configured UI is unreachable, which is the
+        # operator's explicit choice, not a fallback.
+        self.api_prefix = api_urlprefix.rstrip("*").rstrip("/") + "/"
         self.purgatory = Purgatory() if two_step_verification else None
         # Static frontend serving (KafkaCruiseControlApp.setupWebUi + Jetty
         # DefaultServlet; WebServerConfig webserver.ui.diskpath/.urlprefix):
@@ -439,7 +449,7 @@ def _make_handler(app: CruiseControlApp):
 
         def _dispatch(self, method: str):
             parsed = urllib.parse.urlparse(self.path)
-            if not parsed.path.startswith(URL_PREFIX):
+            if not parsed.path.startswith(app.api_prefix):
                 # The API prefix always wins; anything else is the static
                 # frontend when one is configured (Jetty DefaultServlet
                 # semantics: GET only, index.html for the root).  The
@@ -454,7 +464,7 @@ def _make_handler(app: CruiseControlApp):
                 else:
                     self._send(404, {"error": "not found"})
                 return
-            endpoint = parsed.path[len(URL_PREFIX):].strip("/").lower()
+            endpoint = parsed.path[len(app.api_prefix):].strip("/").lower()
             if app.security is not None:
                 from cruise_control_tpu.servlet.security import (
                     permits,

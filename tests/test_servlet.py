@@ -410,3 +410,48 @@ def test_static_ui_carries_spnego_mutual_auth_token(tmp_path):
         assert reply == "Negotiate " + base64.b64encode(b"server-reply").decode()
     finally:
         app.stop()
+
+
+def test_custom_api_urlprefix():
+    """webserver.api.urlprefix relocates the REST mount point."""
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    app = CruiseControlApp(cc, port=0, api_urlprefix="/cc/*")
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        with urllib.request.urlopen(f"{base}/cc/state") as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/kafkacruisecontrol/state")
+        assert ei.value.code == 404
+    finally:
+        app.stop()
+
+
+def test_plugin_class_overrides_via_config():
+    """Explicit *.class keys reflectively override the mode-derived
+    defaults (AbstractConfig.getConfiguredInstance semantics)."""
+    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+    from cruise_control_tpu.main import build_app
+    from cruise_control_tpu.monitor.sample_store import NoopSampleStore
+    from cruise_control_tpu.monitor.sampler import SyntheticWorkloadSampler
+
+    cfg = CruiseControlConfig({
+        "metric.sampler.class":
+            "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler",
+        "sample.store.class":
+            "cruise_control_tpu.monitor.sample_store.NoopSampleStore",
+        "anomaly.notifier.class":
+            "cruise_control_tpu.detector.notifier.SelfHealingNotifier",
+        "min.valid.partition.ratio": 0.25,
+    })
+    app = build_app(cfg, port=0)
+    try:
+        runner = app.cc.task_runner
+        assert isinstance(runner.sampler, SyntheticWorkloadSampler)
+        assert isinstance(runner.sample_store, NoopSampleStore)
+        assert app.cc.default_completeness is not None
+        assert (app.cc.default_completeness
+                .min_monitored_partitions_percentage == 0.25)
+    finally:
+        app.user_tasks.shutdown()
