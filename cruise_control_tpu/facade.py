@@ -251,8 +251,12 @@ class CruiseControl:
         use_cached: bool = False,
     ) -> OperationResult:
         goals = list(goals or self.default_goals)
-        if requirements is None:
-            requirements = self.default_completeness
+        if self.default_completeness is not None:
+            # The operator's min.valid.partition.ratio is a FLOOR: explicit
+            # per-request requirements may only strengthen it.
+            requirements = (self.default_completeness if requirements is None
+                            else requirements.stronger(
+                                self.default_completeness))
         if not dryrun:
             self.executor.set_generating_proposals_for_execution(True)
         try:

@@ -79,7 +79,31 @@ def build_app(config: CruiseControlConfig,
     )
     store_dir = config.get("sample.store.dir")
     mode = config.get("metric.sampler.mode", "synthetic")
-    if store_dir and mode == "reporter":
+    # Reflective plugin overrides (AbstractConfig.getConfiguredInstance):
+    # an explicit *.class key is consulted FIRST so the mode-derived default
+    # (and its side effects — store directories, reporter pipelines) is
+    # never built just to be discarded.  A plugin whose constructor declares
+    # a ``config`` parameter receives the full config, mirroring the
+    # reference's configure(configs) contract.
+    def _plugin(path, **kwargs):
+        import importlib
+        import inspect
+        mod_name, _, cls_name = path.rpartition(".")
+        if not mod_name:
+            raise ConfigError(f"unknown plugin {path}")
+        try:
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+        except (ImportError, AttributeError) as e:
+            raise ConfigError(f"cannot instantiate {path}: {e}") from None
+        if "config" in inspect.signature(cls.__init__).parameters:
+            kwargs["config"] = config
+        return cls(**kwargs)
+
+    sampler_cls = str(config.originals.get("metric.sampler.class", "") or "")
+    store_cls = str(config.originals.get("sample.store.class", "") or "")
+    if store_cls:
+        store = _plugin(store_cls)
+    elif store_dir and mode == "reporter":
         # KafkaSampleStore shape: accepted samples ride the same
         # partitioned-log SPI the reporter publishes on, so a restart
         # replays them with the N-consumer reload (monitor/sample_store.py
@@ -96,7 +120,9 @@ def build_app(config: CruiseControlConfig,
     else:
         store = NoopSampleStore()
     reporters = []
-    if mode == "reporter":
+    if sampler_cls:
+        sampler = _plugin(sampler_cls)
+    elif mode == "reporter":
         # Full ingestion edge: per-broker reporter agents → transport →
         # fan-out consuming sampler (the metrics-reporter pipeline).  With a
         # store dir the metrics bus itself is durable too.
@@ -132,23 +158,6 @@ def build_app(config: CruiseControlConfig,
             endpoint=config["prometheus.server.endpoint"])
     else:
         sampler = SyntheticWorkloadSampler()
-    # Reflective plugin overrides (AbstractConfig.getConfiguredInstance):
-    # an explicit *.class key beats the mode-derived default.  Like the
-    # reference, the plugin receives the config (via a ``config=`` ctor
-    # kwarg); plugins without one are constructed bare.
-    def _plugin(path, **kwargs):
-        from cruise_control_tpu.config.config_def import get_configured_instance
-        try:
-            return get_configured_instance(path, config=config, **kwargs)
-        except TypeError:
-            return get_configured_instance(path, **kwargs)
-
-    sampler_cls = str(config.originals.get("metric.sampler.class", "") or "")
-    if sampler_cls:
-        sampler = _plugin(sampler_cls)
-    store_cls = str(config.originals.get("sample.store.class", "") or "")
-    if store_cls:
-        store = _plugin(store_cls)
     task_runner = LoadMonitorTaskRunner(
         load_monitor, sampler, store,
         sampling_interval_ms=config["metric.sampling.interval.ms"])
@@ -161,17 +170,17 @@ def build_app(config: CruiseControlConfig,
             config["broker.failure.alert.threshold.ms"],
         broker_failure_self_healing_threshold_ms=
             config["broker.failure.self.healing.threshold.ms"])
+    notifier_cls = str(config.originals.get("anomaly.notifier.class", "") or "")
     webhook_url = config.get("anomaly.notifier.webhook.url")
-    if webhook_url:
+    if notifier_cls:
+        notifier = _plugin(notifier_cls, **notifier_kwargs)
+    elif webhook_url:
         from cruise_control_tpu.detector.notifier import WebhookSelfHealingNotifier
         notifier = WebhookSelfHealingNotifier(
             webhook_url, channel=config.get("anomaly.notifier.webhook.channel", ""),
             **notifier_kwargs)
     else:
         notifier = SelfHealingNotifier(**notifier_kwargs)
-    notifier_cls = str(config.originals.get("anomaly.notifier.class", "") or "")
-    if notifier_cls:
-        notifier = _plugin(notifier_cls, **notifier_kwargs)
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,
         constraint=config.balancing_constraint(),

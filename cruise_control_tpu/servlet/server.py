@@ -40,7 +40,6 @@ from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
 
 LOG = logging.getLogger(__name__)
 
-URL_PREFIX = "/kafkacruisecontrol/"
 USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",

@@ -150,3 +150,24 @@ def test_full_stack_goal_convergence():
     # (the sequential reference ships whatever its single pass produced).
     assert res.violated_goals_after == [], res.violated_goals_after
     assert res.balancedness_score == 100.0
+
+
+def test_all_load_distributions_converge():
+    """RandomClusterTest parameter decks: the reference populates random
+    clusters with UNIFORM, LINEAR and EXPONENTIAL resource distributions
+    (common/TestConstants.java) and asserts the goal stack still succeeds.
+    The skewed decks are the hard ones — a few replicas carry most of the
+    load — so the full default stack must end with zero violated goals on
+    each."""
+    from cruise_control_tpu.testing.random_cluster import Distribution
+
+    for dist in (Distribution.UNIFORM, Distribution.LINEAR,
+                 Distribution.EXPONENTIAL):
+        props = rc.ClusterProperties(num_brokers=12, num_racks=4,
+                                     num_topics=16, num_replicas=1000,
+                                     distribution=dist, seed=33)
+        state, placement, meta = rc.generate(props, pad_replicas_to=1024)
+        result = GoalOptimizer(goal_names=list(DEFAULT_GOALS)).optimizations(
+            state, placement, meta)
+        assert result.violated_goals_after == [], (
+            dist, result.violated_goals_after)
