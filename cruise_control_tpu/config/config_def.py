@@ -139,15 +139,35 @@ def load_properties(path: str) -> Dict[str, str]:
 
 
 def get_configured_instance(dotted_or_name: str, registry: Optional[Dict] = None,
-                            **kwargs):
-    """Reflective plugin loading (AbstractConfig.getConfiguredInstance)."""
+                            config=None, **kwargs):
+    """Reflective plugin loading (AbstractConfig.getConfiguredInstance).
+
+    When ``config`` is given, it is passed to the plugin iff its constructor
+    can receive it — a declared ``config`` parameter or a ``**kwargs``
+    catch-all (the Kafka-style ``def __init__(self, **configs)`` shape) —
+    mirroring the reference's configure(configs) contract without breaking
+    plugins that take no configuration."""
     if registry and dotted_or_name in registry:
-        return registry[dotted_or_name](**kwargs)
-    bare = dotted_or_name.rsplit(".", 1)
-    if len(bare) == 2:
-        mod, cls = bare
+        cls = registry[dotted_or_name]
+    else:
+        bare = dotted_or_name.rsplit(".", 1)
+        if len(bare) != 2:
+            raise ConfigError(f"unknown plugin {dotted_or_name}")
+        mod, name = bare
         try:
-            return getattr(importlib.import_module(mod), cls)(**kwargs)
+            cls = getattr(importlib.import_module(mod), name)
         except (ImportError, AttributeError) as e:
-            raise ConfigError(f"cannot instantiate {dotted_or_name}: {e}") from None
-    raise ConfigError(f"unknown plugin {dotted_or_name}")
+            raise ConfigError(
+                f"cannot instantiate {dotted_or_name}: {e}") from None
+    if config is not None and cls.__init__ is not object.__init__:
+        # (object.__init__'s signature advertises *args/**kwargs but a
+        # class without its own __init__ takes no arguments at all.)
+        import inspect
+        try:
+            params = inspect.signature(cls.__init__).parameters.values()
+        except (TypeError, ValueError):
+            params = ()
+        if any(p.kind is p.VAR_KEYWORD or p.name == "config"
+               for p in params):
+            kwargs["config"] = config
+    return cls(**kwargs)

@@ -31,6 +31,13 @@ class ClusterAdminBackend(Protocol):
 
     def finished(self, task: ExecutionTask) -> bool: ...
 
+    def offline_logdirs(self) -> Dict[int, List[int]]:
+        """broker id → offline logdir ids (reference:
+        ``AdminClient.describeLogDirs`` as used by
+        ``DiskFailureDetector.java:1-118``); the disk-failure detector polls
+        this through the executor's backend."""
+        ...
+
     def set_throttles(self, rate_bytes_per_s: Optional[int],
                       partitions: Sequence[TP],
                       brokers: Sequence[int] = (),
@@ -58,6 +65,8 @@ class FakeClusterBackend:
         self.throttled_partitions: List[TP] = []
         self.throttled_brokers: List[int] = []
         self.reassignment_log: List[TP] = []
+        # Fault injection for disk-failure tests: broker → offline dir ids.
+        self.offline_disks: Dict[int, List[int]] = {}
 
     # ------------------------------------------------------------- execute
 
@@ -115,6 +124,9 @@ class FakeClusterBackend:
         self.throttle_rate = rate_bytes_per_s
         self.throttled_partitions = list(partitions)
         self.throttled_brokers = list(brokers)
+
+    def offline_logdirs(self) -> Dict[int, List[int]]:
+        return {b: list(d) for b, d in self.offline_disks.items() if d}
 
     def clear_throttles(self) -> None:
         self.throttle_rate = None

@@ -55,6 +55,7 @@ class BrokerSimulator:
         self._logdir: Dict[Tuple[str, int, int], Dict] = {}
         self._election: Dict[TP, Dict] = {}
         self.failed_brokers: set = set()
+        self.offline_logdirs: Dict[int, set] = {}
         self.broker_configs: Dict[int, Dict[str, str]] = {}
         self.topic_configs: Dict[str, Dict[str, str]] = {}
         # Audit trail for test assertions.
@@ -246,6 +247,21 @@ class BrokerSimulator:
 
     def op_restore_broker(self, req):
         self.failed_brokers.discard(int(req["broker"]))
+
+    def op_fail_logdir(self, req):
+        """Fault injection: mark one broker logdir offline (the state the
+        reference's DiskFailureDetector reads via describeLogDirs)."""
+        self.offline_logdirs.setdefault(int(req["broker"]), set()).add(
+            int(req["logdir"]))
+
+    def op_restore_logdir(self, req):
+        dirs = self.offline_logdirs.get(int(req["broker"]))
+        if dirs:
+            dirs.discard(int(req["logdir"]))
+
+    def op_describe_log_dirs(self, req):
+        return {"offline": {str(b): sorted(d)
+                            for b, d in self.offline_logdirs.items() if d}}
 
     def op_stats(self, req):
         return {"max_inflight": self.max_inflight,

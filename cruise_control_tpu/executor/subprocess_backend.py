@@ -204,6 +204,11 @@ class SubprocessClusterBackend:
         return {(r["topic"], int(r["partition"]))
                 for r in resp["reassignments"]}
 
+    def offline_logdirs(self) -> Dict[int, List[int]]:
+        resp = self.request("describe_log_dirs")
+        return {int(b): [int(x) for x in dirs]
+                for b, dirs in resp.get("offline", {}).items()}
+
     def finished(self, task: ExecutionTask) -> bool:
         p = task.proposal
         try:

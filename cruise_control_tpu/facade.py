@@ -186,6 +186,17 @@ class CruiseControl:
             except Exception as e:          # noqa: BLE001 — keep the daemon up
                 LOG.warning("proposal precompute failed: %s", e)
 
+    def _offline_logdirs(self):
+        """Disk-failure source: the executor's cluster backend answers the
+        describeLogDirs-shaped query (DiskFailureDetector.java:1-118);
+        backends without the query report no failures rather than breaking
+        detection wholesale."""
+        backend = getattr(self.executor, "backend", None)
+        query = getattr(backend, "offline_logdirs", None)
+        if query is None:
+            return {}
+        return query()
+
     def _build_anomaly_detector(self, self_healing_goals,
                                 interval_s) -> AnomalyDetectorManager:
         detectors = {
@@ -193,7 +204,8 @@ class CruiseControl:
                 self.load_monitor, goal_names=self_healing_goals),
             AnomalyType.BROKER_FAILURE: BrokerFailureDetector(
                 self.load_monitor.metadata_client),
-            AnomalyType.DISK_FAILURE: DiskFailureDetector(lambda: {}),
+            AnomalyType.DISK_FAILURE: DiskFailureDetector(
+                self._offline_logdirs),
             AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(
                 self.load_monitor.broker_aggregator),
             AnomalyType.TOPIC_ANOMALY: TopicAnomalyDetector(

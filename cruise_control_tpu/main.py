@@ -86,18 +86,8 @@ def build_app(config: CruiseControlConfig,
     # a ``config`` parameter receives the full config, mirroring the
     # reference's configure(configs) contract.
     def _plugin(path, **kwargs):
-        import importlib
-        import inspect
-        mod_name, _, cls_name = path.rpartition(".")
-        if not mod_name:
-            raise ConfigError(f"unknown plugin {path}")
-        try:
-            cls = getattr(importlib.import_module(mod_name), cls_name)
-        except (ImportError, AttributeError) as e:
-            raise ConfigError(f"cannot instantiate {path}: {e}") from None
-        if "config" in inspect.signature(cls.__init__).parameters:
-            kwargs["config"] = config
-        return cls(**kwargs)
+        from cruise_control_tpu.config.config_def import get_configured_instance
+        return get_configured_instance(path, config=config, **kwargs)
 
     sampler_cls = str(config.originals.get("metric.sampler.class", "") or "")
     store_cls = str(config.originals.get("sample.store.class", "") or "")

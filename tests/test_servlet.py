@@ -455,3 +455,26 @@ def test_plugin_class_overrides_via_config():
                 .min_monitored_partitions_percentage == 0.25)
     finally:
         app.user_tasks.shutdown()
+
+
+def test_get_configured_instance_config_passing():
+    """Plugin config contract: a declared ``config`` param or a Kafka-style
+    ``**configs`` catch-all receives the config; bare classes don't."""
+    from cruise_control_tpu.config.config_def import get_configured_instance
+
+    class Declared:
+        def __init__(self, config=None):
+            self.config = config
+
+    class CatchAll:
+        def __init__(self, **configs):
+            self.config = configs.get("config")
+
+    class Bare:
+        pass
+
+    reg = {"Declared": Declared, "CatchAll": CatchAll, "Bare": Bare}
+    cfg = {"k": "v"}
+    assert get_configured_instance("Declared", reg, config=cfg).config is cfg
+    assert get_configured_instance("CatchAll", reg, config=cfg).config is cfg
+    assert get_configured_instance("Bare", reg, config=cfg) is not None

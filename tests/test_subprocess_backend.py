@@ -324,3 +324,36 @@ def test_simulator_main_stdio_roundtrip():
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_offline_logdir_detection_through_subprocess(backend):
+    """describeLogDirs parity: a logdir failed in the broker-simulator
+    process surfaces through the backend query and fires DiskFailures in
+    the detector (DiskFailureDetector.java:1-118)."""
+    from cruise_control_tpu.detector.detectors import DiskFailureDetector
+
+    assert backend.offline_logdirs() == {}
+    det = DiskFailureDetector(backend.offline_logdirs)
+    assert det.detect() == []
+    backend.request("fail_logdir", broker=2, logdir=1)
+    backend.request("fail_logdir", broker=2, logdir=0)
+    assert backend.offline_logdirs() == {2: [0, 1]}
+    anomalies = det.detect()
+    assert len(anomalies) == 1
+    assert anomalies[0].failed_disks == {2: [0, 1]}
+    backend.request("restore_logdir", broker=2, logdir=0)
+    backend.request("restore_logdir", broker=2, logdir=1)
+    assert det.detect() == []
+
+
+def test_facade_disk_failure_detector_reads_executor_backend():
+    """The assembled service's disk-failure detector polls the executor's
+    cluster backend, not a stub."""
+    from tests.test_facade import build_stack
+
+    cc, backend, cluster = build_stack(num_brokers=4, partitions=8)
+    cc.executor.backend.offline_disks = {1: [0]}
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    det = cc.anomaly_detector.detectors[AnomalyType.DISK_FAILURE]
+    anomalies = det.detect()
+    assert len(anomalies) == 1 and anomalies[0].failed_disks == {1: [0]}
