@@ -132,6 +132,30 @@ def balancedness_score(goal_infos: Sequence[GoalOptimizationInfo],
     return 100.0 * got / total if total else 100.0
 
 
+def _scenario_masks(gctx, state, meta, scenario_sets, revive: bool):
+    """Per-lane (alive, excl_move, excl_lead) masks for what-if batches.
+
+    ``revive=False`` decommissions each lane's brokers (dead + excluded as
+    destinations, the RemoveBrokersRunnable semantics).  ``revive=True``
+    brings each lane's provisioned-but-dead brokers up — liveness only:
+    operator-stated exclusions (OptimizationOptions) are NOT cleared, a dead
+    broker is blocked by ``state.alive`` in the structural checks, never by
+    the exclusion masks."""
+    s_n = len(scenario_sets)
+    id_to_idx = {int(bid): i for i, bid in enumerate(meta.broker_ids)}
+    alive_s = np.tile(np.asarray(state.alive), (s_n, 1))
+    excl_move_s = np.tile(np.asarray(gctx.excluded_for_replica_move), (s_n, 1))
+    excl_lead_s = np.tile(np.asarray(gctx.excluded_for_leadership), (s_n, 1))
+    for s, ids in enumerate(scenario_sets):
+        for bid in ids:
+            i = id_to_idx[int(bid)]
+            alive_s[s, i] = revive
+            if not revive:
+                excl_move_s[s, i] = True
+                excl_lead_s[s, i] = True
+    return alive_s, excl_move_s, excl_lead_s
+
+
 @dataclass
 class BatchScenarioResult:
     """Result of a vmapped what-if batch (one lane per scenario).
@@ -140,7 +164,7 @@ class BatchScenarioResult:
     run N times sequentially; here all N solves share one compiled program.
     """
 
-    removal_sets: List[List[int]]
+    scenario_sets: List[List[int]]   # per-lane broker ids (removed or added)
     goal_names: List[str]
     violated_after: np.ndarray      # i32[S, G] violated brokers per scenario/goal
     moves: np.ndarray               # i32[S, G]
@@ -150,7 +174,12 @@ class BatchScenarioResult:
 
     @property
     def num_scenarios(self) -> int:
-        return len(self.removal_sets)
+        return len(self.scenario_sets)
+
+    @property
+    def removal_sets(self) -> List[List[int]]:
+        """Back-compat alias (the field predates add-scenario batches)."""
+        return self.scenario_sets
 
     def succeeded(self, s: int) -> bool:
         """Scenario s evacuated everything and satisfies every goal."""
@@ -366,27 +395,50 @@ class GoalOptimizer:
         costs one compiled solve per goal.  Scenario-dependent context (host
         capacity) is recomputed inside the trace.
         """
-        import jax
-        import jax.numpy as jnp
-
         options = options or OptimizationOptions()
         goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
         gctx = build_context(state, placement, meta, self.constraint, options)
 
-        s_n = len(removal_sets)
-        id_to_idx = {int(bid): i for i, bid in enumerate(meta.broker_ids)}
-        base_alive = np.asarray(state.alive)
-        base_excl_move = np.asarray(gctx.excluded_for_replica_move)
-        base_excl_lead = np.asarray(gctx.excluded_for_leadership)
-        alive_s = np.tile(base_alive, (s_n, 1))
-        excl_move_s = np.tile(base_excl_move, (s_n, 1))
-        excl_lead_s = np.tile(base_excl_lead, (s_n, 1))
-        for s, ids in enumerate(removal_sets):
-            for bid in ids:
-                i = id_to_idx[int(bid)]
-                alive_s[s, i] = False
-                excl_move_s[s, i] = True
-                excl_lead_s[s, i] = True
+        masks = _scenario_masks(gctx, state, meta, removal_sets, revive=False)
+        return self._run_mask_scenarios(gctx, state, placement, goals,
+                                        num_candidates, removal_sets, *masks)
+
+    def batch_add_scenarios(
+        self,
+        state: ClusterState,
+        placement: Placement,
+        meta: ClusterMeta,
+        addition_sets: Sequence[Sequence[int]],
+        options: Optional[OptimizationOptions] = None,
+        goals: Optional[Sequence[Goal]] = None,
+        num_candidates: int = 512,
+    ) -> BatchScenarioResult:
+        """Add-broker what-ifs as vmapped lanes (the AddBrokersRunnable
+        analog of :meth:`batch_remove_scenarios`).
+
+        ``state`` carries every CANDIDATE broker already provisioned but
+        dead (``alive=False``, no replicas); each lane revives its addition
+        set, and the count/distribution goals pull load onto the empty
+        arrivals.  One compiled solve per goal covers the whole fleet of
+        expansion studies."""
+        options = options or OptimizationOptions()
+        goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
+        gctx = build_context(state, placement, meta, self.constraint, options)
+
+        masks = _scenario_masks(gctx, state, meta, addition_sets, revive=True)
+        return self._run_mask_scenarios(gctx, state, placement, goals,
+                                        num_candidates, addition_sets, *masks)
+
+    def _run_mask_scenarios(self, gctx, state, placement, goals,
+                            num_candidates, scenario_sets,
+                            alive_s, excl_move_s, excl_lead_s
+                            ) -> BatchScenarioResult:
+        """Shared lane runner: one vmapped solve per goal over per-lane
+        liveness/exclusion masks."""
+        import jax
+        import jax.numpy as jnp
+
+        s_n = len(scenario_sets)
         alive_j = jnp.asarray(alive_s)
         excl_move_j = jnp.asarray(excl_move_s)
         excl_lead_j = jnp.asarray(excl_lead_s)
@@ -424,7 +476,7 @@ class GoalOptimizer:
         stranded = np.asarray(stranded_d)
 
         return BatchScenarioResult(
-            removal_sets=[list(map(int, ids)) for ids in removal_sets],
+            scenario_sets=[list(map(int, ids)) for ids in scenario_sets],
             goal_names=[g.name for g in goals],
             violated_after=violated,
             moves=moves,

@@ -412,3 +412,43 @@ def test_dst_pruned_tiles_match_full_scan_quality():
     rounds_p = sum(g.rounds for g in r_pruned.goal_infos)
     rounds_f = sum(g.rounds for g in r_full.goal_infos)
     assert rounds_p <= 3 * max(rounds_f, 1), (rounds_p, rounds_f)
+
+
+def test_batch_add_scenarios():
+    """Add-broker what-if lanes: candidate brokers are provisioned dead in
+    the base snapshot; each lane revives a different subset and the count/
+    distribution goals must pull load onto exactly the revived ones."""
+    from cruise_control_tpu.testing import random_cluster as rc
+    props = rc.ClusterProperties(num_brokers=8, num_racks=4, num_topics=12,
+                                 num_replicas=256, seed=13)
+    state, placement, meta = rc.generate(props)
+    # Provision two candidate brokers as present-but-dead (no replicas).
+    alive = np.asarray(state.alive).copy()
+    valid = np.asarray(state.broker_valid)
+    candidates = [6, 7]
+    for b in candidates:
+        assert valid[b]
+        alive[b] = False
+    # Their replicas must move off first so the base snapshot is a cluster
+    # of 6 with two empty expansion brokers: re-home via a remove solve.
+    opt = GoalOptimizer(goal_names=[
+        "RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal"])
+    base = opt.batch_remove_scenarios(state, placement, meta,
+                                      [candidates], num_candidates=64)
+    assert int(base.stranded_after[0]) == 0
+    placement0 = base.placement_for(0)
+    import jax
+    state6 = state.replace(alive=jax.numpy.asarray(alive))
+
+    addition_sets = [[6], [7], [6, 7]]
+    res = opt.batch_add_scenarios(state6, placement0, meta, addition_sets,
+                                  num_candidates=64)
+    assert res.num_scenarios == 3
+    for s, ids in enumerate(addition_sets):
+        assert int(res.violated_after[s].sum()) == 0, (s, res.violated_after[s])
+        brokers = np.asarray(res.placement_for(s).broker)[np.asarray(state.valid)]
+        for bid in ids:
+            assert (brokers == bid).any(), f"lane {s}: broker {bid} got nothing"
+        for bid in set(candidates) - set(ids):
+            assert (brokers != bid).all(), \
+                f"lane {s}: dead candidate {bid} received replicas"
