@@ -272,6 +272,13 @@ class Goal:
 
     # ------------------------------------------------------ pull (move-in)
 
+    def pull_dst_prune_score(self, gctx: GoalContext, placement: Placement,
+                             agg: Aggregates):
+        """Optional f32[B] for tiling the PULL phase's destination axis
+        (same contract as dst_prune_score): higher = needier receiver.
+        None (default) = scan every broker."""
+        return None
+
     def pull_dst_mask(self, gctx: GoalContext, placement: Placement,
                       agg: Aggregates) -> jnp.ndarray:
         """bool[B]: brokers that need load moved IN (e.g. empty new brokers)."""

@@ -124,6 +124,12 @@ class ReplicaDistributionGoal(Goal):
         _, lower = self._bounds(gctx, agg)
         return (self._counts(gctx, agg) < lower) & alive_mask(gctx)
 
+    def pull_dst_prune_score(self, gctx, placement, agg):
+        """Largest count deficit first."""
+        _, lower = self._bounds(gctx, agg)
+        deficit = (lower - self._counts(gctx, agg)).astype(jnp.float32)
+        return jnp.where(alive_mask(gctx), deficit, -jnp.inf)
+
     def pull_candidate_score(self, gctx, placement, agg):
         state = gctx.state
         c = self._counts(gctx, agg)

@@ -331,6 +331,12 @@ class ResourceDistributionGoal(Goal):
         under = (agg.broker_load[:, res] < lower) & alive_mask(gctx)
         return under & lower_active
 
+    def pull_dst_prune_score(self, gctx, placement, agg):
+        """Neediest under-band brokers first (deficit to the lower bound)."""
+        _, lower, lower_active = self._bounds(gctx, agg)
+        deficit = lower - agg.broker_load[:, self.resource]
+        return jnp.where(alive_mask(gctx) & lower_active, deficit, -jnp.inf)
+
     def pull_candidate_score(self, gctx, placement, agg):
         """Pull from brokers above cluster-average utilization."""
         res = self.resource

@@ -917,12 +917,15 @@ class GoalSolver:
                                          prune_fn=goal.dst_prune_score,
                                          max_dst=self.max_dst_candidates))
         if goal.has_pull_phase:
-            # The pull phase's destinations are the violated (under-band)
-            # brokers themselves — already masked; pruning adds nothing.
+            # Pull destinations are the under-band brokers; the mask alone
+            # does not shrink the C×B pair tile, so they prune too (by
+            # deficit) — measured 147 -> ~60 ms/round at north-star scale.
             phases.append(_replica_phase(goal, priors, c,
                                          goal.pull_candidate_score, goal.self_ok,
                                          dst_mask_fn=goal.pull_dst_mask,
-                                         jitter_frac=self.dst_jitter_frac))
+                                         jitter_frac=self.dst_jitter_frac,
+                                         prune_fn=goal.pull_dst_prune_score,
+                                         max_dst=self.max_dst_candidates))
         if goal.has_swap_phase:
             # Swap pairs are C×C; the tile stays modest (multi-swap keeps
             # whole sub-batches of it per round).
