@@ -395,13 +395,9 @@ class GoalOptimizer:
         costs one compiled solve per goal.  Scenario-dependent context (host
         capacity) is recomputed inside the trace.
         """
-        options = options or OptimizationOptions()
-        goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
-        gctx = build_context(state, placement, meta, self.constraint, options)
-
-        masks = _scenario_masks(gctx, state, meta, removal_sets, revive=False)
-        return self._run_mask_scenarios(gctx, state, placement, goals,
-                                        num_candidates, removal_sets, *masks)
+        return self._batch_scenarios(state, placement, meta, removal_sets,
+                                     revive=False, options=options,
+                                     goals=goals, num_candidates=num_candidates)
 
     def batch_add_scenarios(
         self,
@@ -421,13 +417,19 @@ class GoalOptimizer:
         set, and the count/distribution goals pull load onto the empty
         arrivals.  One compiled solve per goal covers the whole fleet of
         expansion studies."""
-        options = options or OptimizationOptions()
-        goals = list(goals) if goals is not None else get_goals_by_priority(self.goal_names)
-        gctx = build_context(state, placement, meta, self.constraint, options)
+        return self._batch_scenarios(state, placement, meta, addition_sets,
+                                     revive=True, options=options,
+                                     goals=goals, num_candidates=num_candidates)
 
-        masks = _scenario_masks(gctx, state, meta, addition_sets, revive=True)
+    def _batch_scenarios(self, state, placement, meta, scenario_sets, revive,
+                         options, goals, num_candidates) -> BatchScenarioResult:
+        options = options or OptimizationOptions()
+        goals = (list(goals) if goals is not None
+                 else get_goals_by_priority(self.goal_names))
+        gctx = build_context(state, placement, meta, self.constraint, options)
+        masks = _scenario_masks(gctx, state, meta, scenario_sets, revive=revive)
         return self._run_mask_scenarios(gctx, state, placement, goals,
-                                        num_candidates, addition_sets, *masks)
+                                        num_candidates, scenario_sets, *masks)
 
     def _run_mask_scenarios(self, gctx, state, placement, goals,
                             num_candidates, scenario_sets,
