@@ -101,6 +101,18 @@ def _monitor_def() -> ConfigDef:
     # "synthetic" (default) | "reporter" (metrics-reporter pipeline through
     # the transport) | "prometheus" — demo-mode sampler selection.
     d.define("metric.sampler.mode", ConfigType.STRING, "synthetic")
+    # Network face of the metrics bus (the role the Kafka listener plays for
+    # __CruiseControlMetrics): 0 disables; any other port serves the
+    # reporter-mode transport over TCP so external broker agents can publish
+    # with reporter.SocketTransport.
+    d.define("metrics.transport.listen.port", ConfigType.INT, 0,
+             doc="TCP port serving the metrics-bus transport; 0 = in-process "
+                 "only.  Requires metric.sampler.mode=reporter (and no "
+                 "metric.sampler.class override) — otherwise the port is "
+                 "ignored with a warning")
+    d.define("metrics.transport.listen.address", ConfigType.STRING, "127.0.0.1",
+             doc="bind address for the metrics-bus listener (set 0.0.0.0 for "
+                 "remote broker agents)")
     d.define("num.metric.fetchers", ConfigType.INT, 4)
     d.define("prometheus.server.endpoint", ConfigType.STRING, "")
     d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,

@@ -152,6 +152,23 @@ def build_app(config: CruiseControlConfig,
         load_monitor, sampler, store,
         sampling_interval_ms=config["metric.sampling.interval.ms"])
     task_runner.reporters = reporters
+    bus_port = int(config["metrics.transport.listen.port"])
+    if bus_port and mode == "reporter" and not sampler_cls:
+        # Network face of the metrics bus: external broker agents publish to
+        # this listener with reporter.SocketTransport; the in-process
+        # consuming sampler reads the same underlying log.
+        from cruise_control_tpu.reporter import TransportServer
+        bus_server = TransportServer(
+            transport, host=config["metrics.transport.listen.address"],
+            port=bus_port)
+        # Started/stopped with the sampling machinery (the task runner
+        # start()s and stop()s everything in its reporters list).
+        task_runner.reporters = list(reporters) + [bus_server]
+    elif bus_port:
+        logging.getLogger(__name__).warning(
+            "metrics.transport.listen.port=%d ignored: it serves the "
+            "reporter-mode transport (metric.sampler.mode=reporter, no "
+            "metric.sampler.class override)", bus_port)
     executor = Executor(FakeClusterBackend(backend),
                         config.executor_config())
     notifier_kwargs = dict(

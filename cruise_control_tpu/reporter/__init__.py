@@ -12,11 +12,14 @@ from cruise_control_tpu.reporter.serde import (
 from cruise_control_tpu.reporter.transport import (
     FileTransport,
     InProcessTransport,
+    SocketTransport,
+    TransportServer,
     Transport,
 )
 
 __all__ = [
     "BrokerMetricsSource", "DemoBrokerMetricsSource", "MetricsReporter",
     "METRIC_VERSION", "UnknownVersionError", "deserialize_metric",
-    "serialize_metric", "FileTransport", "InProcessTransport", "Transport",
+    "serialize_metric", "FileTransport", "InProcessTransport",
+    "SocketTransport", "TransportServer", "Transport",
 ]

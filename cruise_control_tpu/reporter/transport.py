@@ -139,3 +139,163 @@ class FileTransport:
                 out.append(rec)
                 pos = f.tell()
             return out, pos
+
+
+class TransportServer:
+    """Expose a Transport on a TCP listener — the bus's broker side.
+
+    The reference's metrics bus is a Kafka topic: broker-side reporter
+    plugins PRODUCE over the network and the service's samplers CONSUME
+    partitioned.  This server gives any local Transport (file-backed for
+    durability, in-process for tests) that network face: newline-delimited
+    JSON frames with base64 payloads, ops ``meta`` / ``append`` / ``poll``.
+    Thread-per-connection is plenty at control-plane rates.
+    """
+
+    def __init__(self, transport: Transport, host: str = "127.0.0.1",
+                 port: int = 0):
+        import socketserver
+
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                import base64
+                import json
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        op = req.get("op")
+                        if op == "meta":
+                            resp = {"ok": True, "num_partitions":
+                                    outer.transport.num_partitions}
+                        elif op == "append":
+                            outer.transport.append(
+                                int(req["p"]),
+                                base64.b64decode(req["rec"]))
+                            resp = {"ok": True}
+                        elif op == "poll":
+                            recs, nxt = outer.transport.poll(
+                                int(req["p"]), int(req["off"]),
+                                int(req.get("max", 10_000)))
+                            resp = {"ok": True, "next": nxt,
+                                    "recs": [base64.b64encode(r).decode()
+                                             for r in recs]}
+                        else:
+                            resp = {"ok": False,
+                                    "error": f"unknown op {op!r}"}
+                    except Exception as e:   # noqa: BLE001 — report per frame
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.transport = transport
+        self._server = Server((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="metrics-transport")
+        self._thread.start()
+
+    def stop(self) -> None:
+        # BaseServer.shutdown() blocks on an event only serve_forever sets —
+        # a built-but-never-started server must not hang the caller.
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+
+class SocketTransport:
+    """Transport client over TCP — the role the Kafka producer/consumer
+    clients play for the reference's ``__CruiseControlMetrics`` topic.
+    Reporter agents on remote brokers publish through this; the service's
+    consuming samplers can equally read a remote bus.  One connection,
+    reconnected on failure; calls are serialized (each agent/fetcher owns
+    its own instance)."""
+
+    def __init__(self, address: str, timeout_s: float = 10.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout = timeout_s
+        self._sock = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        self._num_partitions: int | None = None
+
+    def _request(self, req: dict, idempotent: bool = True) -> dict:
+        import json
+        import socket
+
+        with self._lock:
+            for attempt in (0, 1):
+                sent = False
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            self._addr, timeout=self._timeout)
+                        self._rfile = self._sock.makefile("rb")
+                    self._sock.sendall((json.dumps(req) + "\n").encode())
+                    sent = True
+                    line = self._rfile.readline()
+                    if not line:
+                        raise ConnectionError("transport peer closed")
+                    resp = json.loads(line)
+                    if not resp.get("ok"):
+                        raise RuntimeError(
+                            f"transport error: {resp.get('error')}")
+                    return resp
+                except (OSError, ConnectionError):
+                    self._close_locked()
+                    # A lost RESPONSE may mean the server already applied
+                    # the request; blind resend would double-apply appends
+                    # (at-least-once → duplicate metrics).  Retry only
+                    # idempotent ops, or failures from before the send.
+                    if attempt or (sent and not idempotent):
+                        raise
+        raise AssertionError("unreachable")
+
+    def _close_locked(self) -> None:
+        for f in (self._rfile, self._sock):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+        self._sock = self._rfile = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    @property
+    def num_partitions(self) -> int:
+        if self._num_partitions is None:
+            self._num_partitions = int(self._request(
+                {"op": "meta"})["num_partitions"])
+        return self._num_partitions
+
+    def append(self, partition: int, record: bytes) -> None:
+        import base64
+        self._request({"op": "append", "p": int(partition),
+                       "rec": base64.b64encode(record).decode()},
+                      idempotent=False)
+
+    def poll(self, partition: int, offset: int,
+             max_records: int = 10_000) -> Tuple[List[bytes], int]:
+        import base64
+        resp = self._request({"op": "poll", "p": int(partition),
+                              "off": int(offset), "max": int(max_records)})
+        return ([base64.b64decode(r) for r in resp["recs"]],
+                int(resp["next"]))

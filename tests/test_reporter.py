@@ -249,3 +249,33 @@ def test_reporter_mode_service_pipeline():
         assert result.optimizer_result.stats_after is not None
     finally:
         app.cc.shutdown()
+
+
+def test_socket_transport_pipeline():
+    """Network face of the metrics bus: remote reporter agents publish over
+    TCP (the role Kafka producers play for __CruiseControlMetrics), the
+    service's consuming sampler reads the same log — here via a second
+    socket client to prove both directions of the wire."""
+    from cruise_control_tpu.reporter import SocketTransport, TransportServer
+
+    backend = _backend()
+    local = InProcessTransport(num_partitions=4)
+    server = TransportServer(local)
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        publish = SocketTransport(addr)
+        assert publish.num_partitions == 4
+        _report_all(backend, publish, 5_000.0)
+        consume = SocketTransport(addr)
+        sampler = ConsumingMetricSampler(consume, num_fetchers=2)
+        result = sampler.get_samples(backend.fetch(), 0.0, 10_000.0)
+        assert len(result.broker_samples) == 3
+        assert len(result.partition_samples) == 9
+        # Raw round-trip: bytes survive the wire exactly.
+        local2, _ = local.poll(0, 0, 5)
+        wire2, _ = SocketTransport(addr).poll(0, 0, 5)
+        assert local2 == wire2
+        publish.close(); consume.close()
+    finally:
+        server.stop()
