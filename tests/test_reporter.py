@@ -279,3 +279,47 @@ def test_socket_transport_pipeline():
         publish.close(); consume.close()
     finally:
         server.stop()
+
+
+def test_service_assembly_serves_metrics_bus():
+    """metrics.transport.listen.port through build_app: the assembled
+    service's bus is reachable over TCP and an external append lands in
+    the same log the consuming sampler reads."""
+    import socket
+
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.main import build_app
+    from cruise_control_tpu.reporter import SocketTransport
+
+    # Probe-then-bind has a TOCTOU window; retry a couple of fresh ports.
+    for attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cfg = CruiseControlConfig({
+            "metric.sampler.mode": "reporter",
+            "metric.sampling.interval.ms": 200,
+            "partition.metrics.window.ms": 500,
+            "num.partition.metrics.windows": 3,
+            "metrics.transport.listen.port": port,
+        })
+        try:
+            app = build_app(cfg, port=0)
+            break
+        except OSError:
+            if attempt == 2:
+                raise
+    app.cc.start_up()
+    try:
+        t = SocketTransport(f"127.0.0.1:{port}")
+        assert t.num_partitions == 8
+        _, end = t.poll(2, 0, 100000)
+        t.append(2, b"external-record")
+        recs, _ = t.poll(2, end, 100000)
+        assert b"external-record" in recs
+        t.close()
+    finally:
+        app.cc.shutdown()
+        app.user_tasks.shutdown()
