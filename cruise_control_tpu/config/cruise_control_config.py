@@ -131,6 +131,15 @@ def _executor_def() -> ConfigDef:
     d.define("default.replication.throttle", ConfigType.LONG, None)
     d.define("task.execution.alerting.threshold.ms", ConfigType.LONG, 90_000)
     d.define("auto.adjust.concurrency", ConfigType.BOOLEAN, False)
+    # Cluster-facing admin driver selection (the reference's executor always
+    # speaks ZK/AdminClient; here the seam is the ClusterAdminBackend
+    # protocol): a class override, or a host:port of a peer speaking the
+    # admin protocol (broker_simulator --listen, or any real driver shim).
+    d.define("executor.admin.backend.class", ConfigType.CLASS, "",
+             doc="ClusterAdminBackend implementation; beats the address key")
+    d.define("executor.admin.backend.address", ConfigType.STRING, "",
+             doc="host:port of an admin-protocol peer (SocketClusterBackend);"
+                 " empty = in-process fake (demo)")
     return d
 
 

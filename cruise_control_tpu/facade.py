@@ -152,6 +152,11 @@ class CruiseControl:
         self.anomaly_detector.shutdown()
         if self.task_runner is not None:
             self.task_runner.shutdown()
+        # Network-facing admin drivers (SocketClusterBackend) hold a live
+        # connection; close it so embedders cycling apps don't leak sockets.
+        close = getattr(self.executor.backend, "close", None)
+        if close is not None:
+            close()
 
     def _interruptible_wait(self) -> bool:
         """True = stop.  Waits the precompute interval in <=1 s slices,

@@ -169,8 +169,24 @@ def build_app(config: CruiseControlConfig,
             "metrics.transport.listen.port=%d ignored: it serves the "
             "reporter-mode transport (metric.sampler.mode=reporter, no "
             "metric.sampler.class override)", bus_port)
-    executor = Executor(FakeClusterBackend(backend),
-                        config.executor_config())
+    admin_cls = str(config.originals.get("executor.admin.backend.class", "")
+                    or "")
+    admin_addr = config["executor.admin.backend.address"]
+    if admin_cls:
+        admin_backend = _plugin(admin_cls)
+    elif admin_addr:
+        from cruise_control_tpu.executor.subprocess_backend import (
+            SocketClusterBackend,
+        )
+        host, _, aport = admin_addr.rpartition(":")
+        if not aport.isdigit():
+            raise ConfigError(
+                "executor.admin.backend.address must be host:port "
+                f"(got {admin_addr!r})")
+        admin_backend = SocketClusterBackend(host or "127.0.0.1", int(aport))
+    else:
+        admin_backend = FakeClusterBackend(backend)
+    executor = Executor(admin_backend, config.executor_config())
     notifier_kwargs = dict(
         self_healing_enabled=config["self.healing.enabled"],
         broker_failure_alert_threshold_ms=

@@ -311,8 +311,8 @@ def test_service_assembly_serves_metrics_bus():
         except OSError:
             if attempt == 2:
                 raise
-    app.cc.start_up()
     try:
+        app.cc.start_up()
         t = SocketTransport(f"127.0.0.1:{port}")
         assert t.num_partitions == 8
         _, end = t.poll(2, 0, 100000)

@@ -357,3 +357,47 @@ def test_facade_disk_failure_detector_reads_executor_backend():
     det = cc.anomaly_detector.detectors[AnomalyType.DISK_FAILURE]
     anomalies = det.detect()
     assert len(anomalies) == 1 and anomalies[0].failed_disks == {1: [0]}
+
+
+def test_service_assembly_connects_socket_admin_backend():
+    """executor.admin.backend.address through build_app: the assembled
+    service's executor drives a NETWORK admin peer (broker_simulator
+    --listen), not the in-process fake."""
+    import subprocess as sp
+    import sys
+
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.subprocess_backend import (
+        SocketClusterBackend,
+    )
+    from cruise_control_tpu.main import build_app
+
+    proc = sp.Popen(
+        [sys.executable, "-m",
+         "cruise_control_tpu.executor.broker_simulator", "--listen", "0"],
+        stdout=sp.PIPE, stderr=sp.DEVNULL, text=True)
+    try:
+        import json as _json
+        import select as _select
+        ready, _, _ = _select.select([proc.stdout], [], [], 20.0)
+        assert ready, "broker_simulator printed no listen banner in 20s"
+        port = int(_json.loads(proc.stdout.readline())["listening"])
+        cfg = CruiseControlConfig(
+            {"executor.admin.backend.address": f"127.0.0.1:{port}"})
+        app = build_app(cfg, port=0)
+        try:
+            admin = app.cc.executor.backend
+            assert isinstance(admin, SocketClusterBackend)
+            # The executor's queries cross the real socket.
+            assert admin.in_progress_reassignments() == set()
+            assert admin.offline_logdirs() == {}
+            admin.request("fail_logdir", broker=1, logdir=0)
+            assert admin.offline_logdirs() == {1: [0]}
+            admin.close()
+        finally:
+            app.user_tasks.shutdown()
+    finally:
+        proc.kill()
+        proc.wait()
