@@ -143,6 +143,15 @@ def _scenario_masks(gctx, state, meta, scenario_sets, revive: bool):
     the exclusion masks."""
     s_n = len(scenario_sets)
     id_to_idx = {int(bid): i for i, bid in enumerate(meta.broker_ids)}
+    unknown = sorted({int(b) for ids in scenario_sets for b in ids}
+                     - id_to_idx.keys())
+    if unknown:
+        # Scenario sets originate from API requests (remove_broker / add_
+        # broker params) — a typo'd id must surface as a clear client error,
+        # not an opaque KeyError from deep inside the batch builder.
+        raise ValueError(
+            f"unknown broker id(s) {unknown} in what-if scenario: not in "
+            f"this cluster model's broker set")
     alive_s = np.tile(np.asarray(state.alive), (s_n, 1))
     excl_move_s = np.tile(np.asarray(gctx.excluded_for_replica_move), (s_n, 1))
     excl_lead_s = np.tile(np.asarray(gctx.excluded_for_leadership), (s_n, 1))

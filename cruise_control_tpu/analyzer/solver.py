@@ -276,15 +276,17 @@ def _stratified_top_dst(gctx: GoalContext, pscore: jnp.ndarray,
     rack-placement-feasible move keeps a destination in the tile; dead or
     invalid brokers ride along with -inf scores and are culled by the
     feasibility mask like any other infeasible pair."""
-    bn = pscore.shape[0]
     order = jnp.argsort(-pscore).astype(jnp.int32)           # best first
     rack_sorted = gctx.state.rack[order]                     # i32[B]
     onehot = (rack_sorted[:, None]
               == jnp.arange(gctx.num_racks, dtype=jnp.int32)[None, :])
     cnt = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
     rank = jnp.take_along_axis(cnt, rack_sorted[:, None], axis=1)[:, 0] - 1
-    # Secondary key keeps global score order within equal ranks.
-    stratified = order[jnp.argsort(rank * bn + jnp.arange(bn, dtype=jnp.int32))]
+    # Stable sort keeps global score order within equal ranks ("order" is
+    # already score-sorted).  NOT a composite rank*B+idx key: that product
+    # overflows int32 past ~46K padded brokers, and int64 silently downcasts
+    # under JAX's default x64-disabled mode.
+    stratified = order[jnp.argsort(rank, stable=True)]
     return stratified[:d]
 
 
@@ -1142,7 +1144,9 @@ class GoalSolver:
 
         ``agg`` lets the caller thread one goal's exact final aggregates into
         the next goal's solve (the placement is unchanged in between); the
-        returned aggregates are always a fresh full recompute."""
+        returned aggregates are a fresh full recompute — or, for zero-round
+        solves, the caller-supplied entry aggregates unchanged (exact either
+        way, since nothing moved)."""
         solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
         if agg is None:
             agg = self.aggregates(gctx, placement)

@@ -111,8 +111,22 @@ def _monitor_def() -> ConfigDef:
                  "metric.sampler.class override) — otherwise the port is "
                  "ignored with a warning")
     d.define("metrics.transport.listen.address", ConfigType.STRING, "127.0.0.1",
-             doc="bind address for the metrics-bus listener (set 0.0.0.0 for "
-                 "remote broker agents)")
+             doc="bind address for the metrics-bus listener.  Binding beyond "
+                 "loopback (0.0.0.0 for remote broker agents) should set "
+                 "metrics.transport.auth.secret.file (and ideally TLS) — a "
+                 "plaintext unauthenticated bus lets anyone who can reach "
+                 "the port forge metrics or read workload data")
+    d.define("metrics.transport.auth.secret.file", ConfigType.STRING, "",
+             doc="file holding the shared secret every bus peer must present "
+                 "as its first frame ({'op':'auth','token':...}); empty = "
+                 "unauthenticated (loopback/demo only).  Reporter agents "
+                 "pass the same secret to reporter.SocketTransport")
+    d.define("metrics.transport.ssl.certfile", ConfigType.STRING, "",
+             doc="PEM cert chain enabling TLS on the metrics-bus listener "
+                 "(same config shape as webserver.ssl.*); empty = plaintext")
+    d.define("metrics.transport.ssl.keyfile", ConfigType.STRING, "",
+             doc="PEM private key for metrics.transport.ssl.certfile "
+                 "(empty when the cert file bundles the key)")
     d.define("num.metric.fetchers", ConfigType.INT, 4)
     d.define("prometheus.server.endpoint", ConfigType.STRING, "")
     d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
@@ -140,6 +154,16 @@ def _executor_def() -> ConfigDef:
     d.define("executor.admin.backend.address", ConfigType.STRING, "",
              doc="host:port of an admin-protocol peer (SocketClusterBackend);"
                  " empty = in-process fake (demo)")
+    d.define("executor.admin.backend.auth.secret.file", ConfigType.STRING, "",
+             doc="file holding the shared secret presented to the admin peer "
+                 "as the connection's first frame (broker_simulator "
+                 "--auth-token-file); empty = unauthenticated (demo only)")
+    d.define("executor.admin.backend.ssl.enable", ConfigType.BOOLEAN, False,
+             doc="wrap the admin connection in TLS; pair with the cafile key "
+                 "to verify the peer (alone it encrypts without verifying)")
+    d.define("executor.admin.backend.ssl.cafile", ConfigType.STRING, "",
+             doc="PEM CA (typically the peer's self-signed cert) pinning the "
+                 "admin peer's identity; implies ssl.enable")
     return d
 
 
@@ -157,6 +181,37 @@ def _anomaly_def() -> ConfigDef:
     d.define("anomaly.notifier.webhook.url", ConfigType.STRING, "")
     d.define("anomaly.notifier.webhook.channel", ConfigType.STRING, "")
     d.define("topic.anomaly.target.replication.factor", ConfigType.INT, None)
+    # Maintenance-plan stream (MaintenanceEventTopicReader analog): plans
+    # arrive over a partitioned-log Transport instead of in-process submit().
+    # Exactly one of address (TCP TransportServer peer) or dir (FileTransport
+    # directory) enables the reader.
+    d.define("maintenance.event.transport.address", ConfigType.STRING, "",
+             doc="host:port of a TransportServer carrying maintenance plans "
+                 "(reporter.SocketTransport consumer); empty = disabled")
+    d.define("maintenance.event.transport.dir", ConfigType.STRING, "",
+             doc="FileTransport directory carrying maintenance plans; "
+                 "empty = disabled.  Ignored when the address key is set")
+    d.define("maintenance.event.transport.auth.secret.file", ConfigType.STRING,
+             "",
+             doc="file holding the shared secret presented to the maintenance "
+                 "bus (required when the TransportServer it points at is "
+                 "secured); empty = unauthenticated")
+    d.define("maintenance.event.transport.ssl.enable", ConfigType.BOOLEAN,
+             False,
+             doc="wrap the maintenance bus connection in TLS; pair with the "
+                 "cafile key to verify the peer")
+    d.define("maintenance.event.transport.ssl.cafile", ConfigType.STRING, "",
+             doc="PEM CA pinning the maintenance bus peer's identity; "
+                 "implies ssl.enable")
+    d.define("maintenance.plan.expiration.ms", ConfigType.LONG, 900_000,
+             doc="validity period of a maintenance plan; older plans read "
+                 "from the stream are discarded "
+                 "(MaintenanceEventTopicReader.java expiration semantics)")
+    d.define("maintenance.event.offsets.path", ConfigType.STRING, "",
+             doc="JSON file persisting the reader's committed offsets "
+                 "(restart resumes instead of replaying); empty = "
+                 "<transport.dir>/consumer-offsets.json when dir mode, else "
+                 "uncommitted")
     return d
 
 

@@ -272,6 +272,12 @@ class BrokerSimulator:
     def op_ping(self, req):
         return {}
 
+    def op_auth(self, req):
+        # Re-auth on an already-authenticated (or auth-free) stream is a
+        # no-op success, so a client configured with a token works against a
+        # token-free peer too.
+        return {}
+
 
 def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
     """Drain one JSON-lines stream; True when a shutdown op arrived."""
@@ -293,18 +299,38 @@ def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
     return False
 
 
-def _serve_tcp(sim: "BrokerSimulator", port: int) -> int:
+def _serve_tcp(sim: "BrokerSimulator", port: int,
+               auth_token: Optional[str] = None,
+               ssl_cert: Optional[str] = None,
+               ssl_key: Optional[str] = None) -> int:
     """Network-facing mode: the same JSON-lines admin protocol over a TCP
-    socket (the shape of the reference's AdminClient->broker network edge).
-    Prints the bound port on stdout so a parent with port 0 can connect.
-    One client at a time — an admin protocol, not a data plane."""
+    socket (the shape of the reference's AdminClient->broker network edge —
+    which inherits the cluster's SASL/SSL security).  Prints the bound port
+    on stdout so a parent with port 0 can connect.  One client at a time —
+    an admin protocol, not a data plane.
+
+    With ``auth_token`` set, each connection's first frame must be
+    ``{"op": "auth", "token": <token>}``; anything else gets one error reply
+    and a disconnect — an unauthenticated peer cannot move replicas or read
+    cluster state.  ``ssl_cert``/``ssl_key`` wrap the listener in TLS,
+    protecting the token and the admin stream in transit."""
+    import hmac
     import socket
 
     srv = socket.create_server(("127.0.0.1", port))
+    if ssl_cert:
+        from cruise_control_tpu.utils.netsec import server_ssl_context
+        srv = server_ssl_context(ssl_cert, ssl_key).wrap_socket(
+            srv, server_side=True)
     print(json.dumps({"listening": srv.getsockname()[1]}), flush=True)
     try:
         while True:
-            conn, _ = srv.accept()
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                # TLS handshake failure from a bad client must not kill the
+                # listener.
+                continue
             with conn:
                 rfile = conn.makefile("r", encoding="utf-8")
                 wfile = conn.makefile("w", encoding="utf-8")
@@ -314,6 +340,25 @@ def _serve_tcp(sim: "BrokerSimulator", port: int) -> int:
                     wfile.flush()
 
                 try:
+                    if auth_token is not None:
+                        first = rfile.readline()
+                        try:
+                            req = json.loads(first)
+                        except (ValueError, TypeError):
+                            req = {}
+                        if not isinstance(req, dict):
+                            # Valid-but-non-object JSON ('5', '[]') must be
+                            # an auth rejection, not an AttributeError that
+                            # unwinds the whole listener.
+                            req = {}
+                        if req.get("op") != "auth" or not hmac.compare_digest(
+                                str(req.get("token", "")), auth_token):
+                            write(json.dumps(
+                                {"id": req.get("id"), "ok": False,
+                                 "error": "authentication required"}) + "\n")
+                            continue
+                        write(json.dumps(
+                            {"id": req.get("id"), "ok": True}) + "\n")
                     if _serve_stream(sim, rfile, write):
                         return 0
                 except OSError:
@@ -332,7 +377,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         polls = int(args[args.index("--polls-to-finish") + 1])
     sim = BrokerSimulator(polls_to_finish=polls)
     if "--listen" in args:
-        return _serve_tcp(sim, int(args[args.index("--listen") + 1]))
+        token = None
+        if "--auth-token-file" in args:
+            # A file, not argv: command lines are world-readable (/proc).
+            with open(args[args.index("--auth-token-file") + 1]) as f:
+                token = f.read().strip()
+        cert = (args[args.index("--ssl-cert") + 1]
+                if "--ssl-cert" in args else None)
+        key = (args[args.index("--ssl-key") + 1]
+               if "--ssl-key" in args else None)
+        return _serve_tcp(sim, int(args[args.index("--listen") + 1]),
+                          auth_token=token, ssl_cert=cert, ssl_key=key)
 
     out = sys.stdout
 

@@ -371,11 +371,18 @@ class SocketClusterBackend(SubprocessClusterBackend):
     """
 
     def __init__(self, host: str, port: int, request_timeout_s: float = 10.0,
-                 proc: Optional[subprocess.Popen] = None):
+                 proc: Optional[subprocess.Popen] = None,
+                 auth_secret: Optional[str] = None,
+                 ssl_enable: bool = False,
+                 ssl_cafile: Optional[str] = None):
         import socket
 
-        self._sock = socket.create_connection((host, port),
-                                              timeout=request_timeout_s)
+        sock = socket.create_connection((host, port),
+                                        timeout=request_timeout_s)
+        if ssl_enable or ssl_cafile:
+            from cruise_control_tpu.utils.netsec import client_ssl_context
+            sock = client_ssl_context(ssl_cafile).wrap_socket(sock)
+        self._sock = sock
         # Keep a socket timeout as the mid-line backstop: select() only
         # bounds time-to-FIRST-byte, so a peer stalling after half a reply
         # would otherwise block readline() forever with self._lock held.  A
@@ -385,16 +392,32 @@ class SocketClusterBackend(SubprocessClusterBackend):
         super().__init__(proc, request_timeout_s=request_timeout_s)
         self._rstream = self._sock.makefile("r", encoding="utf-8")
         self._wstream = self._sock.makefile("w", encoding="utf-8")
+        if auth_secret is not None:
+            # First frame on the wire must authenticate (broker_simulator
+            # --auth-token-file semantics); a rejection surfaces as the
+            # BackendTransportError this raises.
+            self.request("auth", token=auth_secret)
 
     @classmethod
     def spawn_networked(cls, partitions: Sequence[Dict],
                         polls_to_finish: int = 2,
-                        request_timeout_s: float = 10.0) -> "SocketClusterBackend":
+                        request_timeout_s: float = 10.0,
+                        auth_token_file: Optional[str] = None,
+                        auth_secret: Optional[str] = None,
+                        ssl_cert: Optional[str] = None,
+                        ssl_key: Optional[str] = None,
+                        ssl_cafile: Optional[str] = None) -> "SocketClusterBackend":
+        cmd = [sys.executable, "-m",
+               "cruise_control_tpu.executor.broker_simulator",
+               "--polls-to-finish", str(polls_to_finish), "--listen", "0"]
+        if auth_token_file:
+            cmd += ["--auth-token-file", auth_token_file]
+        if ssl_cert:
+            cmd += ["--ssl-cert", ssl_cert]
+        if ssl_key:
+            cmd += ["--ssl-key", ssl_key]
         proc = subprocess.Popen(
-            [sys.executable, "-m",
-             "cruise_control_tpu.executor.broker_simulator",
-             "--polls-to-finish", str(polls_to_finish), "--listen", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
         # The listener prints its bound port as the first line.  Any failure
         # from here on must reap the child — an orphaned listener survives
         # in accept() holding a port.
@@ -412,7 +435,10 @@ class SocketClusterBackend(SubprocessClusterBackend):
                 raise BackendTransportError(
                     f"bad listener banner {first!r}: {e}") from e
             backend = cls("127.0.0.1", port,
-                          request_timeout_s=request_timeout_s, proc=proc)
+                          request_timeout_s=request_timeout_s, proc=proc,
+                          auth_secret=auth_secret,
+                          ssl_enable=bool(ssl_cert or ssl_cafile),
+                          ssl_cafile=ssl_cafile)
             backend.request("bootstrap", partitions=list(partitions))
             return backend
         except Exception:

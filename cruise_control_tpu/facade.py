@@ -121,6 +121,12 @@ class CruiseControl:
         self._precompute_stop = threading.Event()
         self._precompute_thread: Optional[threading.Thread] = None
         self._precomputed_generation = None
+        # Optional bus consumer feeding the MaintenanceEventDetector
+        # (MaintenanceEventTopicReader analog) — assembled by the bootstrap
+        # when maintenance.event.transport.* is configured; owned here so its
+        # lifecycle rides start_up/shutdown like the reference's reader rides
+        # the AnomalyDetectorManager's.
+        self.maintenance_reader = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -129,6 +135,8 @@ class CruiseControl:
         if self.task_runner is not None:
             self.task_runner.start()
         self.anomaly_detector.start_detection()
+        if self.maintenance_reader is not None:
+            self.maintenance_reader.start()
         if self._precompute_interval_s > 0:
             # Non-daemon: a daemon thread killed inside native XLA code at
             # interpreter exit aborts the process; a non-daemon thread makes
@@ -143,6 +151,8 @@ class CruiseControl:
             self._precompute_thread.start()
 
     def shutdown(self) -> None:
+        if self.maintenance_reader is not None:
+            self.maintenance_reader.stop()
         self._precompute_stop.set()
         if self._precompute_thread is not None:
             self._precompute_thread.join(timeout=5.0)

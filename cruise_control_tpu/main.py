@@ -158,9 +158,22 @@ def build_app(config: CruiseControlConfig,
         # this listener with reporter.SocketTransport; the in-process
         # consuming sampler reads the same underlying log.
         from cruise_control_tpu.reporter import TransportServer
+        secret_file = config["metrics.transport.auth.secret.file"]
+        bus_secret = None
+        if secret_file:
+            with open(secret_file) as f:
+                bus_secret = f.read().strip()
+        bind = config["metrics.transport.listen.address"]
+        if bind not in ("127.0.0.1", "localhost", "::1") and not bus_secret:
+            logging.getLogger(__name__).warning(
+                "metrics bus bound to %s with NO authentication — any peer "
+                "that can reach the port can forge metrics or read workload "
+                "data; set metrics.transport.auth.secret.file (and TLS)",
+                bind)
         bus_server = TransportServer(
-            transport, host=config["metrics.transport.listen.address"],
-            port=bus_port)
+            transport, host=bind, port=bus_port, auth_secret=bus_secret,
+            ssl_certfile=config["metrics.transport.ssl.certfile"] or None,
+            ssl_keyfile=config["metrics.transport.ssl.keyfile"] or None)
         # Started/stopped with the sampling machinery (the task runner
         # start()s and stop()s everything in its reporters list).
         task_runner.reporters = list(reporters) + [bus_server]
@@ -183,7 +196,15 @@ def build_app(config: CruiseControlConfig,
             raise ConfigError(
                 "executor.admin.backend.address must be host:port "
                 f"(got {admin_addr!r})")
-        admin_backend = SocketClusterBackend(host or "127.0.0.1", int(aport))
+        admin_secret_file = config["executor.admin.backend.auth.secret.file"]
+        admin_secret = None
+        if admin_secret_file:
+            with open(admin_secret_file) as f:
+                admin_secret = f.read().strip()
+        admin_backend = SocketClusterBackend(
+            host or "127.0.0.1", int(aport), auth_secret=admin_secret,
+            ssl_enable=config["executor.admin.backend.ssl.enable"],
+            ssl_cafile=config["executor.admin.backend.ssl.cafile"] or None)
     else:
         admin_backend = FakeClusterBackend(backend)
     executor = Executor(admin_backend, config.executor_config())
@@ -219,6 +240,42 @@ def build_app(config: CruiseControlConfig,
             int(config["topic.anomaly.target.replication.factor"])
             if config.originals.get("topic.anomaly.target.replication.factor")
             else None))
+    maint_addr = config["maintenance.event.transport.address"]
+    maint_dir = config["maintenance.event.transport.dir"]
+    if maint_addr or maint_dir:
+        # Maintenance plans from the message bus (MaintenanceEventTopicReader
+        # analog): a TCP TransportServer peer or a FileTransport directory
+        # feeds the MaintenanceEventDetector with committed offsets.
+        import os as _os
+
+        from cruise_control_tpu.detector.anomalies import AnomalyType
+        from cruise_control_tpu.detector.maintenance_reader import (
+            MaintenanceEventReader,
+        )
+        if maint_addr:
+            from cruise_control_tpu.reporter import SocketTransport
+            m_secret_file = config[
+                "maintenance.event.transport.auth.secret.file"]
+            m_secret = None
+            if m_secret_file:
+                with open(m_secret_file) as f:
+                    m_secret = f.read().strip()
+            maint_transport = SocketTransport(
+                maint_addr, auth_secret=m_secret,
+                ssl_enable=config["maintenance.event.transport.ssl.enable"],
+                ssl_cafile=config["maintenance.event.transport.ssl.cafile"]
+                or None)
+        else:
+            from cruise_control_tpu.reporter import FileTransport
+            maint_transport = FileTransport(maint_dir, num_partitions=8)
+        offsets_path = config["maintenance.event.offsets.path"] or (
+            _os.path.join(maint_dir, "consumer-offsets.json")
+            if maint_dir else None)
+        cc.maintenance_reader = MaintenanceEventReader(
+            maint_transport,
+            cc.anomaly_detector.detectors[AnomalyType.MAINTENANCE_EVENT],
+            offsets_path=offsets_path,
+            expiration_ms=config["maintenance.plan.expiration.ms"])
     ssl_on = config["webserver.ssl.enable"]
     if ssl_on and not config["webserver.ssl.certfile"]:
         hint = ""

@@ -141,31 +141,91 @@ class FileTransport:
             return out, pos
 
 
+#: Upper bound on one request frame (metrics records are KB-scale; the
+#: base64 of the largest sane record is far below this).  Bounding the
+#: readline keeps one misbehaving peer from buffering an unbounded line into
+#: service memory.
+MAX_FRAME_BYTES = 4 * 1024 * 1024
+
+
 class TransportServer:
     """Expose a Transport on a TCP listener — the bus's broker side.
 
     The reference's metrics bus is a Kafka topic: broker-side reporter
     plugins PRODUCE over the network and the service's samplers CONSUME
-    partitioned.  This server gives any local Transport (file-backed for
-    durability, in-process for tests) that network face: newline-delimited
-    JSON frames with base64 payloads, ops ``meta`` / ``append`` / ``poll``.
-    Thread-per-connection is plenty at control-plane rates.
+    partitioned — inheriting Kafka's SASL/SSL/ACLs.  This server gives any
+    local Transport (file-backed for durability, in-process for tests) that
+    network face: newline-delimited JSON frames with base64 payloads, ops
+    ``meta`` / ``append`` / ``poll``.  Thread-per-connection is plenty at
+    control-plane rates.
+
+    Security (the role Kafka's listener security plays): ``auth_secret``
+    requires every connection's FIRST frame to be
+    ``{"op": "auth", "token": <secret>}`` — anything else is rejected and
+    the connection closed, so an unauthenticated peer can neither forge
+    metrics nor read workload data.  ``ssl_certfile``/``ssl_keyfile`` wrap
+    the listener in TLS (same PEM config shape as the web server), which
+    also protects the token in transit.  Plaintext + no secret is demo-only:
+    bind it to loopback.
     """
 
+    #: Bound on the per-connection TLS handshake; a peer that connects and
+    #: goes silent is dropped after this instead of pinning its thread.
+    HANDSHAKE_TIMEOUT_S = 15.0
+
     def __init__(self, transport: Transport, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, auth_secret: str | None = None,
+                 ssl_certfile: str | None = None,
+                 ssl_keyfile: str | None = None):
         import socketserver
 
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
+            def setup(self):
+                # TLS is wrapped HERE, in the per-connection thread — never
+                # on the listening socket, where one stalled peer's handshake
+                # would block the accept loop (and every other agent) until
+                # it went away.
+                if outer._ssl_ctx is not None:
+                    self.request.settimeout(outer.HANDSHAKE_TIMEOUT_S)
+                    self.request = outer._ssl_ctx.wrap_socket(
+                        self.request, server_side=True)
+                    self.request.settimeout(None)
+                super().setup()
+
             def handle(self):
                 import base64
+                import hmac
                 import json
-                for line in self.rfile:
+                authed = outer.auth_secret is None
+                while True:
+                    line = self.rfile.readline(MAX_FRAME_BYTES)
+                    if not line:
+                        return
+                    if len(line) >= MAX_FRAME_BYTES and \
+                            not line.endswith(b"\n"):
+                        # Oversized frame: answer once, then drop the peer —
+                        # the rest of the line would have to be drained
+                        # (unbounded) to resync the stream.
+                        self._reply({"ok": False, "error":
+                                     "frame exceeds MAX_FRAME_BYTES"})
+                        return
                     try:
                         req = json.loads(line)
                         op = req.get("op")
+                        if not authed:
+                            if op == "auth" and hmac.compare_digest(
+                                    str(req.get("token", "")),
+                                    outer.auth_secret):
+                                authed = True
+                                self._reply({"ok": True})
+                                continue
+                            # Wrong token or any op before auth: one error
+                            # frame, then disconnect (no guessing loop).
+                            self._reply({"ok": False,
+                                         "error": "authentication required"})
+                            return
                         if op == "meta":
                             resp = {"ok": True, "num_partitions":
                                     outer.transport.num_partitions}
@@ -181,21 +241,40 @@ class TransportServer:
                             resp = {"ok": True, "next": nxt,
                                     "recs": [base64.b64encode(r).decode()
                                              for r in recs]}
+                        elif op == "auth":
+                            resp = {"ok": True}      # idempotent re-auth
                         else:
                             resp = {"ok": False,
                                     "error": f"unknown op {op!r}"}
                     except Exception as e:   # noqa: BLE001 — report per frame
                         resp = {"ok": False,
                                 "error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write(
-                        (json.dumps(resp) + "\n").encode())
-                    self.wfile.flush()
+                    self._reply(resp)
+
+            def _reply(self, resp) -> None:
+                import json
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+            def handle_error(self, request, client_address):
+                # Failed TLS handshakes / timeouts from scanners and broken
+                # peers are expected noise — one log line, not a traceback.
+                import logging
+                import sys
+                logging.getLogger(__name__).warning(
+                    "transport connection from %s failed: %s",
+                    client_address, sys.exc_info()[1])
+
         self.transport = transport
+        self.auth_secret = auth_secret
+        self._ssl_ctx = None
+        if ssl_certfile:
+            from cruise_control_tpu.utils.netsec import server_ssl_context
+            self._ssl_ctx = server_ssl_context(ssl_certfile, ssl_keyfile)
         self._server = Server((host, port), Handler)
         self._thread: threading.Thread | None = None
 
@@ -225,27 +304,48 @@ class SocketTransport:
     reconnected on failure; calls are serialized (each agent/fetcher owns
     its own instance)."""
 
-    def __init__(self, address: str, timeout_s: float = 10.0):
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 auth_secret: str | None = None,
+                 ssl_enable: bool = False,
+                 ssl_cafile: str | None = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout_s
+        self._auth_secret = auth_secret
+        self._ssl_enable = ssl_enable or bool(ssl_cafile)
+        self._ssl_cafile = ssl_cafile
         self._sock = None
         self._rfile = None
         self._lock = threading.Lock()
         self._num_partitions: int | None = None
 
-    def _request(self, req: dict, idempotent: bool = True) -> dict:
+    def _connect_locked(self):
         import json
         import socket
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        if self._ssl_enable:
+            from cruise_control_tpu.utils.netsec import client_ssl_context
+            sock = client_ssl_context(self._ssl_cafile).wrap_socket(sock)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        if self._auth_secret is not None:
+            # Authenticate the fresh connection before replaying the caller's
+            # request (TransportServer requires auth as the first frame).
+            sock.sendall((json.dumps(
+                {"op": "auth", "token": self._auth_secret}) + "\n").encode())
+            line = self._rfile.readline()
+            if not line or not json.loads(line).get("ok"):
+                raise ConnectionError("transport authentication rejected")
+
+    def _request(self, req: dict, idempotent: bool = True) -> dict:
+        import json
 
         with self._lock:
             for attempt in (0, 1):
                 sent = False
                 try:
                     if self._sock is None:
-                        self._sock = socket.create_connection(
-                            self._addr, timeout=self._timeout)
-                        self._rfile = self._sock.makefile("rb")
+                        self._connect_locked()
                     self._sock.sendall((json.dumps(req) + "\n").encode())
                     sent = True
                     line = self._rfile.readline()

@@ -1,0 +1,44 @@
+"""Shared TLS context construction for the framework's TCP faces.
+
+One canonical copy of the client/server SSL setup used by the metrics bus
+(``reporter/transport.py``), the admin driver (``executor/
+subprocess_backend.py``) and the admin listener (``executor/
+broker_simulator.py``) — a hardening change (minimum version, cipher policy,
+hostname rules) lands everywhere at once instead of drifting per copy.
+Import-light on purpose: the broker simulator must keep starting in
+milliseconds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def client_ssl_context(cafile: Optional[str] = None):
+    """TLS context for a framework client connection.
+
+    With ``cafile`` the peer's chain is verified against it (typically the
+    peer's own self-signed cert — a pin).  Hostname checking is off either
+    way: these private endpoints are addressed by IP:port, not by the
+    cert's DNS name, so the CA pin is the trust anchor.  Without ``cafile``
+    the link is encrypted but unverified — an explicit opt-in for
+    demo/test topologies.
+    """
+    import ssl
+
+    if cafile:
+        ctx = ssl.create_default_context(cafile=cafile)
+        ctx.check_hostname = False
+    else:
+        ctx = ssl._create_unverified_context()  # noqa: S323 — opt-in
+    return ctx
+
+
+def server_ssl_context(certfile: str, keyfile: Optional[str] = None):
+    """TLS context for a framework listener (PEM chain + key, the same
+    config shape as the web server's webserver.ssl.* keys)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile or None)
+    return ctx

@@ -323,3 +323,108 @@ def test_service_assembly_serves_metrics_bus():
     finally:
         app.cc.shutdown()
         app.user_tasks.shutdown()
+
+
+def test_transport_server_shared_secret_auth():
+    """Authenticated metrics bus (the role Kafka SASL/ACLs play for
+    __CruiseControlMetrics): the right secret can append/poll; a wrong
+    secret or an op-before-auth is rejected and disconnected, so an
+    unauthenticated peer can neither forge metrics nor read them."""
+    import socket
+
+    from cruise_control_tpu.reporter import (
+        InProcessTransport,
+        SocketTransport,
+        TransportServer,
+    )
+
+    local = InProcessTransport(num_partitions=2)
+    server = TransportServer(local, auth_secret="bus-secret")
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        good = SocketTransport(addr, auth_secret="bus-secret")
+        good.append(0, b"metric-record")
+        recs, _ = good.poll(0, 0)
+        assert recs == [b"metric-record"]
+        good.close()
+
+        with pytest.raises((ConnectionError, OSError)):
+            SocketTransport(addr, auth_secret="wrong").append(0, b"forged")
+        assert local.record_count(0) == 1        # nothing forged
+
+        # Op before auth: one error frame, then disconnect.
+        import json as _json
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=10) as s:
+            s.sendall(b'{"op": "poll", "p": 0, "off": 0}\n')
+            resp = _json.loads(s.makefile("rb").readline())
+            assert resp["ok"] is False and "auth" in resp["error"]
+    finally:
+        server.stop()
+
+
+def test_transport_server_oversized_frame_rejected(monkeypatch):
+    """A single unbounded line cannot buffer the service into OOM: frames
+    past MAX_FRAME_BYTES get one error reply and a disconnect."""
+    import socket
+
+    from cruise_control_tpu.reporter import InProcessTransport, TransportServer
+    from cruise_control_tpu.reporter import transport as transport_mod
+
+    monkeypatch.setattr(transport_mod, "MAX_FRAME_BYTES", 1024)
+    server = TransportServer(InProcessTransport(num_partitions=1))
+    server.start()
+    try:
+        import json as _json
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=10) as s:
+            s.sendall(b'{"op": "append", "p": 0, "rec": "' +
+                      b"A" * 4096 + b'"}\n')
+            f = s.makefile("rb")
+            resp = _json.loads(f.readline())
+            assert resp["ok"] is False and "MAX_FRAME" in resp["error"]
+            assert f.readline() == b""           # peer disconnected us
+    finally:
+        server.stop()
+
+
+@pytest.mark.skipif(__import__("shutil").which("openssl") is None,
+                    reason="openssl CLI not available")
+def test_transport_server_tls(tmp_path):
+    """TLS metrics bus (webserver.ssl-shaped PEM config): a CA-pinned
+    authenticated client round-trips records; a plaintext client cannot."""
+    import subprocess
+    import sys as _sys
+
+    from cruise_control_tpu.reporter import (
+        InProcessTransport,
+        SocketTransport,
+        TransportServer,
+    )
+
+    cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    server = TransportServer(InProcessTransport(num_partitions=2),
+                             auth_secret="bus-secret",
+                             ssl_certfile=str(cert), ssl_keyfile=str(key))
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        client = SocketTransport(addr, auth_secret="bus-secret",
+                                 ssl_cafile=str(cert))
+        client.append(1, b"over-tls")
+        recs, _ = client.poll(1, 0)
+        assert recs == [b"over-tls"]
+        client.close()
+
+        plain = SocketTransport(addr, auth_secret="bus-secret",
+                                timeout_s=5.0)
+        with pytest.raises((ConnectionError, OSError)):
+            plain.append(0, b"plaintext")
+    finally:
+        server.stop()

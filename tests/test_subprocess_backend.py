@@ -401,3 +401,95 @@ def test_service_assembly_connects_socket_admin_backend():
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_socket_backend_shared_secret_auth(tmp_path):
+    """Authenticated admin listener (the role Kafka SASL plays for the
+    reference's AdminClient edge): the right token works, a missing or
+    wrong token is rejected before any admin op executes."""
+    import socket
+
+    from cruise_control_tpu.executor.subprocess_backend import (
+        SocketClusterBackend,
+    )
+
+    token_file = tmp_path / "admin.secret"
+    token_file.write_text("s3cret-token\n")
+    backend = SocketClusterBackend.spawn_networked(
+        bootstrap_partitions(), polls_to_finish=1,
+        auth_token_file=str(token_file), auth_secret="s3cret-token")
+    port = backend._sock.getpeername()[1]
+    try:
+        assert len(backend.describe_topics()) == 4   # authed stream works
+        # Release the (serial) listener without shutting the simulator down
+        # (the makefile streams hold io-refs: the fd only really closes — and
+        # the server only sees EOF — once they are closed too).
+        backend._rstream.close()
+        backend._wstream.close()
+        backend._sock.close()
+
+        def raw_exchange(payload: bytes) -> dict:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=10) as s:
+                s.sendall(payload)
+                return json.loads(s.makefile("r").readline())
+
+        # Wrong token: one error frame, disconnected.
+        resp = raw_exchange(b'{"id": 1, "op": "auth", "token": "nope"}\n')
+        assert resp["ok"] is False and "auth" in resp["error"]
+        # No auth at all: the first admin op is rejected, not executed.
+        resp = raw_exchange(b'{"id": 1, "op": "describe_topics"}\n')
+        assert resp["ok"] is False and "auth" in resp["error"]
+
+        # Rejections cost nothing: a correctly-authed reconnect still sees
+        # the bootstrapped cluster state.
+        again = SocketClusterBackend("127.0.0.1", port,
+                                     auth_secret="s3cret-token")
+        assert len(again.describe_topics()) == 4
+        again.proc = backend.proc        # let close() reap the child
+        backend.proc = None
+        again.close()
+    finally:
+        backend.close()
+
+
+@pytest.mark.skipif(__import__("shutil").which("openssl") is None,
+                    reason="openssl CLI not available")
+def test_socket_backend_tls(tmp_path):
+    """TLS admin listener: a CA-pinned client completes admin ops; a
+    plaintext client cannot speak to it (and does not crash the listener)."""
+    from cruise_control_tpu.executor.subprocess_backend import (
+        BackendTransportError,
+        SocketClusterBackend,
+    )
+
+    cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    backend = SocketClusterBackend.spawn_networked(
+        bootstrap_partitions(), polls_to_finish=1,
+        ssl_cert=str(cert), ssl_key=str(key), ssl_cafile=str(cert))
+    port = backend._sock.getpeername()[1]
+    try:
+        assert len(backend.describe_topics()) == 4   # TLS stream works
+        backend._rstream.close()                     # release the listener
+        backend._wstream.close()
+        backend._sock.close()
+
+        with pytest.raises(BackendTransportError):
+            plain = SocketClusterBackend("127.0.0.1", port,
+                                         request_timeout_s=5.0)
+            plain.describe_topics()
+
+        # The failed handshake did not kill the listener.
+        again = SocketClusterBackend("127.0.0.1", port,
+                                     ssl_cafile=str(cert))
+        assert len(again.describe_topics()) == 4
+        again.proc = backend.proc
+        backend.proc = None
+        again.close()
+    finally:
+        backend.close()
