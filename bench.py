@@ -14,7 +14,11 @@ All five BASELINE.md configs, one JSON line each (headline LAST):
   north-star scale (<10 s budget on one v5e chip).
 - config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
   vmapped scenario batch through the production
-  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack).
+  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack), in FOUR rows:
+  the round-comparable lane batch (cold + warm), ONE scenario decommissioning
+  64 brokers at once (the reference's RemoveBrokersRunnable removes a *set*
+  in one operation — BASELINE's literal shape), and the full 64-lane batch
+  even on the CPU fallback.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see

@@ -60,6 +60,13 @@ class CapacityGoal(Goal):
     def self_ok(self, gctx, placement, agg, r, dst):
         return self.accept_replica_move(gctx, placement, agg, r, dst)
 
+    # NOTE: an own-resource dst_cost + hard-cap dst_prune_score were
+    # measured here and REVERTED: CpuCapacityGoal's round got 190 ms
+    # cheaper, but the single-resource placement of its ~4K moves cost
+    # CpuUsageDistributionGoal two extra rounds downstream (+380 ms) at
+    # north-star scale — the generic all-resource emptiest-after-move cost
+    # is load-bearing for the goals solved later.
+
     def accept_replica_move(self, gctx, placement, agg, r, dst):
         res = self.resource
         load = replica_role_load(gctx, placement, r)[..., res]

@@ -88,6 +88,13 @@ class ResourceDistributionGoal(Goal):
     # ------------------------------------------------------- move-out phase
 
     def candidate_score(self, gctx, placement, agg):
+        # NOTE: heaviest-replica-first, deliberately.  A gap-weighted
+        # interleave across violated brokers (the swap-tile design) was
+        # measured here and REVERTED: the tail rounds at north-star scale
+        # are acceptance-bound (prior goals' bands veto the moves), not
+        # tile-membership-bound, so fair tile shares bought nothing and the
+        # changed priority order cost LeaderReplicaDistribution a residual
+        # violation.
         state = gctx.state
         over = self._over_brokers(gctx, agg)
         prio = self.replica_priority(gctx, placement, agg)
@@ -144,6 +151,35 @@ class ResourceDistributionGoal(Goal):
         upper, _, _ = self._bounds(gctx, agg)
         head = upper - agg.broker_load[:, self.resource]
         return jnp.where(alive_mask(gctx), head, -jnp.inf)
+
+    def dst_prune_score_vs(self, gctx, placement, agg, priors):
+        """Priors-aware receiver ranking (worst in-play band first, own-
+        resource tiebreak).  Ranking by THIS resource's headroom alone
+        starves tail rounds at north-star scale: the emptiest receivers for
+        this resource often sit ON a prior distribution goal's upper band,
+        so that prior vetoes every arrival and the round fixes almost
+        nothing.  A receiver's real acceptance odds are bounded by its worst
+        normalized headroom across the bands actually IN PLAY — this goal's
+        plus each prior ResourceDistributionGoal's (goals solved later veto
+        nothing and must not skew the ranking)."""
+        resources = sorted({self.resource} | {
+            g.resource for g in priors
+            if isinstance(g, ResourceDistributionGoal)})
+        if len(resources) == 1:
+            return self.dst_prune_score(gctx, placement, agg)
+        res_idx = jnp.asarray(resources)
+        alive = alive_mask(gctx)[:, None]
+        caps = jnp.maximum(gctx.state.capacity[:, res_idx], 1e-9)   # [B,K]
+        load = agg.broker_load[:, res_idx]                          # [B,K]
+        total = jnp.sum(jnp.where(alive, load, 0.0), axis=0)        # [K]
+        cap_tot = jnp.sum(jnp.where(
+            alive, gctx.state.capacity[:, res_idx], 0.0), axis=0)
+        avg = total / jnp.maximum(cap_tot, 1e-9)                    # [K]
+        upper = avg * gctx.balance_threshold[res_idx] * caps        # [B,K]
+        head_frac = (upper - load) / caps                           # [B,K]
+        own = head_frac[:, resources.index(self.resource)]
+        score = jnp.min(head_frac, axis=-1) + 1e-3 * own
+        return jnp.where(alive_mask(gctx), score, -jnp.inf)
 
     def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
         upper, _, _ = self._bounds(gctx, agg)

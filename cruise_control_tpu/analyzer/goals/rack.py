@@ -50,6 +50,13 @@ def _emptiest_broker_score(gctx, agg):
     return jnp.where(alive_mask(gctx), -jnp.sum(frac, axis=-1), -jnp.inf)
 
 
+# NOTE: a load-independent dst_cost for the rack goals (per-broker fraction
+# broadcast instead of the generic [C,D,4] after-move tensor) was measured
+# and reverted: the round got marginally cheaper but the changed placement
+# pattern cost CpuUsageDistribution two extra rounds downstream — the
+# candidate's own load in the ranking is NOT noise at rack-repair scale.
+
+
 class RackAwareGoal(Goal):
     """Strict rack-awareness (hard)."""
 

@@ -46,8 +46,9 @@ _BALANCEDNESS_WEIGHT_HARD = 3.0
 _BALANCEDNESS_WEIGHT_SOFT = 1.0
 
 
-def _host_local_placement(placement: Placement) -> Placement:
-    """Placement with every leaf addressable on THIS process.
+def _host_local_placement(placement):
+    """The given pytree (typically a Placement) with every leaf addressable
+    on THIS process.
 
     Identity unless a leaf is actually a cross-process sharded global array
     (a GoalOptimizer built WITHOUT the global mesh keeps host-local arrays
@@ -481,6 +482,12 @@ class GoalOptimizer:
              *_rest) = batch(gctx, alive_j, excl_move_j, excl_lead_j, placement_s)
             device_stats.append((rounds_d, moves_d, violated_d))
             priors.append(goal)
+        # Under a multi-process global mesh the per-lane stats and the
+        # stacked placements span non-addressable devices; gather them so
+        # every process reconstructs the same host-local values (identity
+        # single-process).
+        device_stats, stranded_d, placement_s = _host_local_placement(
+            (device_stats, stranded_d, placement_s))
         rounds = np.stack([np.asarray(r) for r, _, _ in device_stats], axis=1)
         moves = np.stack([np.asarray(m) for _, m, _ in device_stats], axis=1)
         violated = np.stack([np.asarray(v) for _, _, v in device_stats], axis=1)

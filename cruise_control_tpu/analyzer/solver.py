@@ -319,19 +319,29 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
         top_score, cand = _top_candidates(score, c, exact=goal.is_hard,
                                           force_exact=force_exact)
         is_cand = top_score > _SCORE_FLOOR
+        run = jnp.any(is_cand)
+        dst_mask = None
+        if dst_mask_fn is not None:
+            # Pull phases: the destination mask (under-band brokers) is the
+            # phase's whole purpose — when it is empty every pair would be
+            # infeasible, so the O(B) mask check skips the C×B tile outright.
+            # At north-star scale most tail rounds have over-band violators
+            # only, making this the common case.
+            dst_mask = dst_mask_fn(gctx, placement, agg)
+            run = run & jnp.any(dst_mask)
         # Zero-candidate rounds skip the whole C×B tile.  Only in UNBATCHED
         # solves: under the what-if vmap the predicate is lane-dependent, so
         # XLA lowers the cond to a select and runs both branches — the skip
         # is inert there, not wrong.
         return jax.lax.cond(
-            jnp.any(is_cand),
+            run,
             lambda pl, ag: _phase_body(gctx, pl, ag, ridx, top_score, cand,
-                                       is_cand),
+                                       is_cand, dst_mask),
             lambda pl, ag: (pl, ag, jnp.int32(0)),
             placement, agg)
 
     def _phase_body(gctx: GoalContext, placement: Placement, agg: Aggregates,
-                    ridx, top_score, cand, is_cand):
+                    ridx, top_score, cand, is_cand, dst_mask=None):
         state = gctx.state
         b = state.num_brokers_padded
         c = num_candidates
@@ -348,9 +358,9 @@ def _replica_phase(goal: Goal, priors: Sequence[Goal], num_candidates: int,
             nd = b
         ok = accept(gctx, placement, agg, r2, d2)
         ok = ok & self_ok_fn(gctx, placement, agg, r2, d2)
-        if dst_mask_fn is not None:
-            m = dst_mask_fn(gctx, placement, agg)
-            ok = ok & (m if dst_ids is None else m[dst_ids])[None, :]
+        if dst_mask is not None:
+            ok = ok & (dst_mask if dst_ids is None
+                       else dst_mask[dst_ids])[None, :]
         cost_raw = goal.dst_cost(gctx, placement, agg, r2, d2)
         cost = jnp.where(ok, cost_raw, _INF_COST)
         # Rank matching: the i-th candidate (priority order) gets the i-th
@@ -900,6 +910,7 @@ class GoalSolver:
         return min(hint, num_replicas_padded)
 
     def _phases(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
+        """(kind, phase_fn) pairs in execution order."""
         phases = []
         if getattr(goal, "is_direct", False):
             def direct(gctx, placement, agg, ridx, force_exact=None,
@@ -909,33 +920,44 @@ class GoalSolver:
                 changed = jnp.sum((new_pl.is_leader != placement.is_leader)
                                   .astype(jnp.int32)) // 2
                 return new_pl, compute_aggregates(gctx, new_pl), changed
-            phases.append(direct)
+            phases.append(("direct", direct))
         if goal.uses_leadership_moves:
-            phases.append(_leadership_phase(goal, priors, c))
+            phases.append(("leadership", _leadership_phase(goal, priors, c)))
         if goal.uses_replica_moves:
-            phases.append(_replica_phase(goal, priors, c,
-                                         goal.candidate_score, goal.self_ok,
-                                         jitter_frac=self.dst_jitter_frac,
-                                         prune_fn=goal.dst_prune_score,
-                                         max_dst=self.max_dst_candidates))
+            # Priors-aware receiver ranking when the goal offers it (the
+            # prune is a heuristic ORDER, so priors only shape which
+            # receivers make the tile — acceptance stays exact either way).
+            prune_vs = getattr(goal, "dst_prune_score_vs", None)
+            prune = (
+                (lambda gctx, pl, ag, _f=prune_vs, _p=priors:
+                 _f(gctx, pl, ag, _p))
+                if prune_vs is not None else goal.dst_prune_score)
+            phases.append(("move",
+                           _replica_phase(goal, priors, c,
+                                          goal.candidate_score, goal.self_ok,
+                                          jitter_frac=self.dst_jitter_frac,
+                                          prune_fn=prune,
+                                          max_dst=self.max_dst_candidates)))
         if goal.has_pull_phase:
             # Pull destinations are the under-band brokers; the mask alone
             # does not shrink the C×B pair tile, so they prune too (by
             # deficit) — measured 147 -> ~60 ms/round at north-star scale.
-            phases.append(_replica_phase(goal, priors, c,
-                                         goal.pull_candidate_score, goal.self_ok,
-                                         dst_mask_fn=goal.pull_dst_mask,
-                                         jitter_frac=self.dst_jitter_frac,
-                                         prune_fn=goal.pull_dst_prune_score,
-                                         max_dst=self.max_dst_candidates))
+            phases.append(("pull",
+                           _replica_phase(goal, priors, c,
+                                          goal.pull_candidate_score, goal.self_ok,
+                                          dst_mask_fn=goal.pull_dst_mask,
+                                          jitter_frac=self.dst_jitter_frac,
+                                          prune_fn=goal.pull_dst_prune_score,
+                                          max_dst=self.max_dst_candidates)))
         if goal.has_swap_phase:
             # Swap pairs are C×C; the tile stays modest (multi-swap keeps
             # whole sub-batches of it per round).
-            phases.append(_swap_phase(goal, priors,
-                                      min(self.max_swap_candidates, c),
-                                      jitter_frac=self.dst_jitter_frac))
+            phases.append(("swap",
+                           _swap_phase(goal, priors,
+                                       min(self.max_swap_candidates, c),
+                                       jitter_frac=self.dst_jitter_frac)))
         if getattr(goal, "intra_disk", False):
-            phases.append(_intra_disk_phase(goal, c))
+            phases.append(("intra_disk", _intra_disk_phase(goal, c)))
         return phases
 
     def _phases_runner(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
@@ -946,7 +968,12 @@ class GoalSolver:
         def run(gctx: GoalContext, placement: Placement, agg: Aggregates,
                 ridx, force_exact=None):
             applied = jnp.int32(0)
-            for phase in phases:
+            # NOTE: all phases run every round, including swap.  Gating the
+            # swap tile on "cheaper phases applied nothing" (the reference's
+            # escalation order) was measured and REVERTED: swaps converge in
+            # parallel with moves here — deferring them took the NW
+            # distribution goals from 3-4 rounds to 8 at north-star scale.
+            for _kind, phase in phases:
                 placement, agg, n = phase(gctx, placement, agg, ridx,
                                           force_exact)
                 applied = applied + n

@@ -94,6 +94,16 @@ def deserialize_plan(record: bytes) -> Dict:
         raise ValueError(
             f"cannot deserialize plan type {plan} version {obj.get('version')}"
             f"; latest supported: {latest}")
+    # Shape-check the fields the reader consumes: a valid-CRC plan with a
+    # missing/mistyped timeMs or brokers must be a per-record drop, not an
+    # exception class that escapes the reader's bad-record handling and
+    # wedges the stream behind it.
+    if not isinstance(obj.get("timeMs"), (int, float)):
+        raise ValueError("maintenance plan missing numeric timeMs")
+    brokers = obj.get("brokers", [])
+    if not (isinstance(brokers, list)
+            and all(isinstance(b, int) for b in brokers)):
+        raise ValueError("maintenance plan brokers must be a list of ints")
     return obj
 
 
@@ -158,24 +168,28 @@ class MaintenanceEventReader:
                     break
                 progressed = True
                 for rec in records:
+                    # The whole per-record path is guarded: any malformed
+                    # field is THIS record's problem — offsets must still
+                    # advance past it or the stream wedges forever.
                     try:
                         plan = deserialize_plan(rec)
-                    except ValueError as e:
+                        stale = (now - float(plan["timeMs"])
+                                 > self._expiration_ms)
+                        event = MaintenanceEvent(
+                            plan=plan["planType"],
+                            broker_ids=tuple(plan.get("brokers", ())),
+                            topic=plan.get("topic"),
+                            replication_factor=plan.get("replicationFactor"))
+                    except (ValueError, TypeError, KeyError) as e:
                         LOG.warning("dropping bad maintenance plan: %s", e)
                         dropped += 1
                         continue
-                    if now - float(plan["timeMs"]) > self._expiration_ms:
+                    if stale:
                         # Stale plan (producer/consumer/network delay past
                         # the validity period) — acting on it now could fight
                         # the operator's current intent.
                         dropped += 1
-                        continue
-                    event = MaintenanceEvent(
-                        plan=plan["planType"],
-                        broker_ids=tuple(plan.get("brokers", ())),
-                        topic=plan.get("topic"),
-                        replication_factor=plan.get("replicationFactor"))
-                    if self._detector.submit(event):
+                    elif self._detector.submit(event):
                         accepted += 1
                     else:
                         dropped += 1          # idempotence-cache duplicate

@@ -302,12 +302,17 @@ def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
 def _serve_tcp(sim: "BrokerSimulator", port: int,
                auth_token: Optional[str] = None,
                ssl_cert: Optional[str] = None,
-               ssl_key: Optional[str] = None) -> int:
+               ssl_key: Optional[str] = None,
+               bind: str = "127.0.0.1") -> int:
     """Network-facing mode: the same JSON-lines admin protocol over a TCP
     socket (the shape of the reference's AdminClient->broker network edge —
     which inherits the cluster's SASL/SSL security).  Prints the bound port
-    on stdout so a parent with port 0 can connect.  One client at a time —
-    an admin protocol, not a data plane.
+    on stdout so a parent with port 0 can connect.
+
+    Clients are served thread-per-connection — a real admin endpoint holds
+    the service's long-lived driver connection AND operator tooling at once
+    — with op handlers serialized by a lock, so cluster state stays
+    consistent across concurrent clients.
 
     With ``auth_token`` set, each connection's first frame must be
     ``{"op": "auth", "token": <token>}``; anything else gets one error reply
@@ -316,56 +321,85 @@ def _serve_tcp(sim: "BrokerSimulator", port: int,
     protecting the token and the admin stream in transit."""
     import hmac
     import socket
+    import threading
 
-    srv = socket.create_server(("127.0.0.1", port))
+    srv = socket.create_server((bind, port))
+    ssl_ctx = None
     if ssl_cert:
         from cruise_control_tpu.utils.netsec import server_ssl_context
-        srv = server_ssl_context(ssl_cert, ssl_key).wrap_socket(
-            srv, server_side=True)
+        ssl_ctx = server_ssl_context(ssl_cert, ssl_key)
     print(json.dumps({"listening": srv.getsockname()[1]}), flush=True)
+    state_lock = threading.Lock()
+    shutdown_evt = threading.Event()
+    raw_handle = sim.handle
+
+    def locked_handle(req):
+        with state_lock:
+            return raw_handle(req)
+
+    sim.handle = locked_handle
+
+    def serve_client(conn):
+        with conn:
+            conn.settimeout(None)   # the accept loop's poll timeout must
+            if ssl_ctx is not None:  # never cut a blocking client read
+                # Handshake in the per-connection thread (never the accept
+                # loop), bounded so a silent peer can't pin its thread.
+                try:
+                    conn.settimeout(15.0)
+                    conn = ssl_ctx.wrap_socket(conn, server_side=True)
+                    conn.settimeout(None)
+                except OSError:
+                    return
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+
+            def write(s: str) -> None:
+                wfile.write(s)
+                wfile.flush()
+
+            try:
+                if auth_token is not None:
+                    first = rfile.readline()
+                    try:
+                        req = json.loads(first)
+                    except (ValueError, TypeError):
+                        req = {}
+                    if not isinstance(req, dict):
+                        # Valid-but-non-object JSON ('5', '[]') must be an
+                        # auth rejection, not an AttributeError that unwinds
+                        # the handler.
+                        req = {}
+                    if req.get("op") != "auth" or not hmac.compare_digest(
+                            str(req.get("token", "")), auth_token):
+                        write(json.dumps(
+                            {"id": req.get("id"), "ok": False,
+                             "error": "authentication required"}) + "\n")
+                        return
+                    write(json.dumps(
+                        {"id": req.get("id"), "ok": True}) + "\n")
+                if _serve_stream(sim, rfile, write):
+                    shutdown_evt.set()
+            except OSError:
+                # Unclean client disconnect (reset mid-read, broken pipe on
+                # reply) must not kill the listener — cluster state survives
+                # across connections.
+                pass
+
     try:
-        while True:
+        srv.settimeout(0.5)
+        while not shutdown_evt.is_set():
             try:
                 conn, _ = srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 # TLS handshake failure from a bad client must not kill the
                 # listener.
                 continue
-            with conn:
-                rfile = conn.makefile("r", encoding="utf-8")
-                wfile = conn.makefile("w", encoding="utf-8")
-
-                def write(s: str) -> None:
-                    wfile.write(s)
-                    wfile.flush()
-
-                try:
-                    if auth_token is not None:
-                        first = rfile.readline()
-                        try:
-                            req = json.loads(first)
-                        except (ValueError, TypeError):
-                            req = {}
-                        if not isinstance(req, dict):
-                            # Valid-but-non-object JSON ('5', '[]') must be
-                            # an auth rejection, not an AttributeError that
-                            # unwinds the whole listener.
-                            req = {}
-                        if req.get("op") != "auth" or not hmac.compare_digest(
-                                str(req.get("token", "")), auth_token):
-                            write(json.dumps(
-                                {"id": req.get("id"), "ok": False,
-                                 "error": "authentication required"}) + "\n")
-                            continue
-                        write(json.dumps(
-                            {"id": req.get("id"), "ok": True}) + "\n")
-                    if _serve_stream(sim, rfile, write):
-                        return 0
-                except OSError:
-                    # Unclean client disconnect (reset mid-read, broken pipe
-                    # on reply) must not kill the listener — accept the next
-                    # client; cluster state survives across connections.
-                    continue
+            threading.Thread(target=serve_client, args=(conn,),
+                             daemon=True).start()
+        return 0
     finally:
         srv.close()
 
@@ -380,14 +414,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         token = None
         if "--auth-token-file" in args:
             # A file, not argv: command lines are world-readable (/proc).
-            with open(args[args.index("--auth-token-file") + 1]) as f:
-                token = f.read().strip()
+            from cruise_control_tpu.utils.netsec import read_secret_file
+            token = read_secret_file(
+                args[args.index("--auth-token-file") + 1], "admin auth token")
         cert = (args[args.index("--ssl-cert") + 1]
                 if "--ssl-cert" in args else None)
         key = (args[args.index("--ssl-key") + 1]
                if "--ssl-key" in args else None)
+        # Remote admin topologies (the reason the auth/TLS flags exist) need
+        # a non-loopback bind; keep loopback the safe default.
+        bind = (args[args.index("--bind") + 1]
+                if "--bind" in args else "127.0.0.1")
         return _serve_tcp(sim, int(args[args.index("--listen") + 1]),
-                          auth_token=token, ssl_cert=cert, ssl_key=key)
+                          auth_token=token, ssl_cert=cert, ssl_key=key,
+                          bind=bind)
 
     out = sys.stdout
 

@@ -289,6 +289,29 @@ class CruiseControl:
         try:
             builder = self.load_monitor.cluster_model_builder(
                 requirements=requirements)
+            # Dead logdirs are the ADMIN backend's knowledge (AdminClient
+            # describeLogDirs in the reference), not the metadata sampler's:
+            # fold them into the model so their replicas solve as offline —
+            # without this, fix_offline_replicas would "fix" a healthy model
+            # and never evacuate the failed disk.  Logdir ids map to the
+            # broker's disk indices (the JBOD contract the capacity resolver
+            # uses).  A transient admin-socket failure must not take down
+            # every optimization operation (the query is an enrichment, and
+            # the anomaly cycle retries) — log it and build without.
+            try:
+                offline = self._offline_logdirs() or {}
+            except Exception as e:   # noqa: BLE001 — network seam
+                LOG.warning("offline-logdir query failed (%s); building the "
+                            "model without dead-disk enrichment", e)
+                offline = {}
+            for b_id, disks in offline.items():
+                for d in disks:
+                    try:
+                        builder.mark_disk_dead(int(b_id), int(d))
+                    except (KeyError, IndexError):
+                        # Broker/disk absent from current metadata (e.g.
+                        # already decommissioned) — nothing to mark.
+                        pass
             if model_mutator is not None:
                 model_mutator(builder)
             state, placement, meta = builder.freeze(pad_replicas_to=PAD_R,

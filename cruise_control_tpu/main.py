@@ -158,11 +158,10 @@ def build_app(config: CruiseControlConfig,
         # this listener with reporter.SocketTransport; the in-process
         # consuming sampler reads the same underlying log.
         from cruise_control_tpu.reporter import TransportServer
+        from cruise_control_tpu.utils.netsec import read_secret_file
         secret_file = config["metrics.transport.auth.secret.file"]
-        bus_secret = None
-        if secret_file:
-            with open(secret_file) as f:
-                bus_secret = f.read().strip()
+        bus_secret = (read_secret_file(secret_file, "metrics bus secret")
+                      if secret_file else None)
         bind = config["metrics.transport.listen.address"]
         if bind not in ("127.0.0.1", "localhost", "::1") and not bus_secret:
             logging.getLogger(__name__).warning(
@@ -196,11 +195,11 @@ def build_app(config: CruiseControlConfig,
             raise ConfigError(
                 "executor.admin.backend.address must be host:port "
                 f"(got {admin_addr!r})")
+        from cruise_control_tpu.utils.netsec import read_secret_file
         admin_secret_file = config["executor.admin.backend.auth.secret.file"]
-        admin_secret = None
-        if admin_secret_file:
-            with open(admin_secret_file) as f:
-                admin_secret = f.read().strip()
+        admin_secret = (read_secret_file(admin_secret_file, "admin backend "
+                                         "secret") if admin_secret_file
+                        else None)
         admin_backend = SocketClusterBackend(
             host or "127.0.0.1", int(aport), auth_secret=admin_secret,
             ssl_enable=config["executor.admin.backend.ssl.enable"],
@@ -254,12 +253,11 @@ def build_app(config: CruiseControlConfig,
         )
         if maint_addr:
             from cruise_control_tpu.reporter import SocketTransport
+            from cruise_control_tpu.utils.netsec import read_secret_file
             m_secret_file = config[
                 "maintenance.event.transport.auth.secret.file"]
-            m_secret = None
-            if m_secret_file:
-                with open(m_secret_file) as f:
-                    m_secret = f.read().strip()
+            m_secret = (read_secret_file(m_secret_file, "maintenance bus "
+                                         "secret") if m_secret_file else None)
             maint_transport = SocketTransport(
                 maint_addr, auth_secret=m_secret,
                 ssl_enable=config["maintenance.event.transport.ssl.enable"],

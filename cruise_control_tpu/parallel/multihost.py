@@ -38,21 +38,33 @@ from cruise_control_tpu.parallel.mesh import make_solver_mesh
 
 
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int) -> None:
+               process_id: int,
+               heartbeat_timeout_s: Optional[int] = None) -> None:
     """Join this process to the distributed runtime.  A repeat call with a
     runtime already up is a no-op (callers may share one bootstrap path);
     ``coordinator_address`` is ``host:port`` of process 0 — reachable over
-    the deployment's control network (DCN)."""
+    the deployment's control network (DCN).
+
+    ``heartbeat_timeout_s`` bounds peer-failure detection: when a process
+    dies mid-solve, every SURVIVOR is terminated by the coordination
+    service with a fatal "tasks are unhealthy (stopped sending heartbeats)"
+    diagnosis after this many seconds, instead of hanging forever in the
+    orphaned collective (the SPMD analog of the reference's ZK session
+    timeout, ``BrokerFailureDetector.java:64-92``).  None keeps the JAX
+    default (100 s); verified by ``tests/test_multihost.py``."""
     try:
         from jax._src.distributed import global_state as _state
     except ImportError:         # private module moved: rely on the
         _state = None           # message-matched RuntimeError below
     if _state is not None and getattr(_state, "client", None) is not None:
         return
+    kwargs = {}
+    if heartbeat_timeout_s is not None:
+        kwargs["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)
     try:
         jax.distributed.initialize(coordinator_address,
                                    num_processes=num_processes,
-                                   process_id=process_id)
+                                   process_id=process_id, **kwargs)
     except RuntimeError as e:
         msg = str(e).lower()
         # jax's wording varies by version: "already initialized" vs
@@ -93,3 +105,27 @@ def propose_multihost(state, placement, meta, goal_names: Optional[Sequence[str]
     opt = GoalOptimizer(constraint=constraint, goal_names=goal_names,
                         mesh=mesh, polish_passes=polish_passes)
     return opt.optimizations(state, placement, meta)
+
+
+def batch_remove_scenarios_multihost(state, placement, meta, scenario_sets,
+                                     goal_names: Optional[Sequence[str]] = None,
+                                     constraint=None,
+                                     scenario_parallelism: int = 2,
+                                     num_candidates: int = 512):
+    """Remove-broker what-if batch on the global mesh — the DP×MP analog
+    (scenario axis data-parallel across hosts, replica axis model-parallel
+    within; BASELINE config #5 at multi-host scale).
+
+    Same SPMD contract as :func:`propose_multihost`: all processes call with
+    same shapes + identical ``meta`` and ``scenario_sets``; process 0's
+    tensor content is broadcast; every process returns the identical
+    :class:`BatchScenarioResult`.
+    """
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    state, placement = broadcast_from_coordinator((state, placement))
+    mesh = global_solver_mesh(scenario_parallelism)
+    opt = GoalOptimizer(constraint=constraint, goal_names=goal_names,
+                        mesh=mesh)
+    return opt.batch_remove_scenarios(state, placement, meta, scenario_sets,
+                                      num_candidates=num_candidates)

@@ -211,21 +211,33 @@ class TransportServer:
                         self._reply({"ok": False, "error":
                                      "frame exceeds MAX_FRAME_BYTES"})
                         return
-                    try:
-                        req = json.loads(line)
-                        op = req.get("op")
-                        if not authed:
-                            if op == "auth" and hmac.compare_digest(
-                                    str(req.get("token", "")),
-                                    outer.auth_secret):
-                                authed = True
-                                self._reply({"ok": True})
-                                continue
-                            # Wrong token or any op before auth: one error
-                            # frame, then disconnect (no guessing loop).
+                    if not authed:
+                        # The auth gate sits OUTSIDE the per-frame error
+                        # handling: any pre-auth frame that is not a valid
+                        # auth op — wrong token, other op, or unparseable
+                        # garbage — gets one error frame and a disconnect.
+                        # (Inside it, malformed lines would loop as per-frame
+                        # errors, letting an unauthenticated peer pin this
+                        # thread forever.)
+                        try:
+                            req = json.loads(line)
+                            ok_auth = (isinstance(req, dict)
+                                       and req.get("op") == "auth"
+                                       and hmac.compare_digest(
+                                           str(req.get("token", "")),
+                                           outer.auth_secret))
+                        except ValueError:
+                            ok_auth = False
+                        if not ok_auth:
                             self._reply({"ok": False,
                                          "error": "authentication required"})
                             return
+                        authed = True
+                        self._reply({"ok": True})
+                        continue
+                    try:
+                        req = json.loads(line)
+                        op = req.get("op")
                         if op == "meta":
                             resp = {"ok": True, "num_partitions":
                                     outer.transport.num_partitions}

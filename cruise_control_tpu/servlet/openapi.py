@@ -1,0 +1,201 @@
+"""OpenAPI spec assembled from the live endpoint surface.
+
+Reference: ``cruise-control/src/yaml/base.yaml`` + ``yaml/endpoints/*.yaml``
++ ``yaml/responses/*.yaml`` — the reference ships a hand-maintained OpenAPI
+tree and ``ResponseTest.java`` validates live responses against it.  Here
+the spec is GENERATED from the same tables the server dispatches on
+(``GET_ENDPOINTS``/``POST_ENDPOINTS``) and the same response schemas the
+tests validate (``schemas.ENDPOINT_SCHEMAS``), so it cannot drift from the
+implementation: a new endpoint without spec metadata fails the build, and
+the committed ``docs/openapi.yaml`` is asserted current by
+``tests/test_servlet.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from cruise_control_tpu.servlet import schemas
+from cruise_control_tpu.servlet.server import GET_ENDPOINTS, POST_ENDPOINTS
+
+API_PREFIX = "/kafkacruisecontrol"
+
+#: endpoint -> (summary, [(param, type, description)], minimum role)
+ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
+    "state": ("Monitor/Executor/Analyzer/AnomalyDetector state", [
+        ("substates", "string", "comma list restricting the sections"),
+        ("verbose", "boolean", "include per-window/selfheal detail"),
+    ], "USER"),
+    "load": ("Per-broker load statistics (ClusterModelStats)", [
+        ("allow_capacity_estimation", "boolean",
+         "permit estimated broker capacities"),
+    ], "USER"),
+    "partition_load": ("Partitions sorted by utilization", [
+        ("entries", "integer", "max records returned"),
+    ], "USER"),
+    "kafka_cluster_state": ("Partition/replica placement as the cluster "
+                            "reports it", [], "VIEWER"),
+    "user_tasks": ("Active and completed async tasks", [], "VIEWER"),
+    "review_board": ("Two-step-verification queue", [], "VIEWER"),
+    "proposals": ("Cached/derived rebalance proposals (dryrun)", [
+        ("goals", "string", "comma list of goal names"),
+        ("kafka_assigner", "boolean", "assigner-mode goal pair"),
+        ("excluded_topics", "string", "regex of topics to exclude"),
+    ], "USER"),
+    "bootstrap": ("Re-ingest historical samples", [
+        ("start", "number", "range start ms"),
+        ("end", "number", "range end ms"),
+    ], "ADMIN"),
+    "train": ("Fit the linear CPU estimation model", [
+        ("start", "number", "range start ms"),
+        ("end", "number", "range end ms"),
+    ], "ADMIN"),
+    "metrics": ("Sensor registry (Prometheus text, or JSON with "
+                "?json=true)", [
+        ("json", "boolean", "JSON snapshot instead of Prometheus text"),
+    ], "VIEWER"),
+    "rebalance": ("Full-cluster rebalance", [
+        ("dryrun", "boolean", "propose only (default true)"),
+        ("goals", "string", "comma list of goal names"),
+        ("kafka_assigner", "boolean", "assigner-mode goal pair"),
+        ("rebalance_disk", "boolean", "balance between each broker's disks"),
+        ("destination_broker_ids", "string", "comma list of allowed targets"),
+        ("excluded_topics", "string", "regex of topics to exclude"),
+        ("only_move_immigrant_replicas", "boolean",
+         "restrict to immigrant replicas"),
+    ], "ADMIN"),
+    "add_broker": ("Move load onto new brokers", [
+        ("brokerid", "string", "comma list of broker ids"),
+        ("dryrun", "boolean", "propose only"),
+        ("goals", "string", "comma list of goal names"),
+        ("throttle_added_broker", "boolean", "apply replication throttle"),
+    ], "ADMIN"),
+    "remove_broker": ("Decommission brokers", [
+        ("brokerid", "string", "comma list of broker ids"),
+        ("dryrun", "boolean", "propose only"),
+        ("goals", "string", "comma list of goal names"),
+        ("destination_broker_ids", "string", "comma list of allowed targets"),
+    ], "ADMIN"),
+    "demote_broker": ("Shed leadership from brokers", [
+        ("brokerid", "string", "comma list of broker ids"),
+        ("dryrun", "boolean", "propose only"),
+    ], "ADMIN"),
+    "fix_offline_replicas": ("Re-replicate offline replicas", [
+        ("dryrun", "boolean", "propose only"),
+        ("goals", "string", "comma list of goal names"),
+    ], "ADMIN"),
+    "topic_configuration": ("Change topic replication factor", [
+        ("topic", "string", "topic regex"),
+        ("replication_factor", "integer", "target RF"),
+        ("dryrun", "boolean", "propose only"),
+        ("goals", "string", "comma list of goal names"),
+    ], "ADMIN"),
+    "stop_proposal_execution": ("Abort the in-flight execution", [], "ADMIN"),
+    "pause_sampling": ("Pause metric sampling", [
+        ("reason", "string", "audit note"),
+    ], "ADMIN"),
+    "resume_sampling": ("Resume metric sampling", [
+        ("reason", "string", "audit note"),
+    ], "ADMIN"),
+    "admin": ("Runtime admin toggles", [
+        ("enable_self_healing_for", "string", "comma list of anomaly types"),
+        ("disable_self_healing_for", "string", "comma list of anomaly types"),
+        ("concurrent_partition_movements_per_broker", "integer",
+         "executor concurrency cap"),
+    ], "ADMIN"),
+    "review": ("Approve/discard parked two-step requests", [
+        ("approve", "string", "comma list of review ids"),
+        ("discard", "string", "comma list of review ids"),
+        ("reason", "string", "audit note"),
+    ], "ADMIN"),
+}
+
+#: Schema components referenced by more than one endpoint get one shared
+#: component name; everything else is named after its endpoint.
+_SHARED = {
+    id(schemas.OPERATION_RESULT_SCHEMA): "OptimizationResult",
+    id(schemas.MESSAGE_SCHEMA): "Message",
+    id(schemas.REVIEW_BOARD_SCHEMA): "ReviewBoard",
+}
+
+ERROR_SCHEMA = {
+    "type": "object",
+    "required": ["error"],
+    "properties": {"error": {"type": "string"}},
+}
+
+PROGRESS_SCHEMA = {
+    "type": "object",
+    "required": ["progress"],
+    "properties": {"progress": {"type": "array",
+                                "items": {"type": "object"}}},
+}
+
+
+def _component_name(endpoint: str) -> str:
+    schema = schemas.ENDPOINT_SCHEMAS[endpoint]
+    return _SHARED.get(id(schema)) or "".join(
+        part.capitalize() for part in endpoint.split("_")) + "Response"
+
+
+def build_spec() -> Dict:
+    """The OpenAPI 3.0 document as a plain dict (YAML-ready)."""
+    missing = (GET_ENDPOINTS | POST_ENDPOINTS) - set(ENDPOINT_INFO)
+    if missing:
+        raise AssertionError(
+            f"endpoints without OpenAPI metadata: {sorted(missing)} — add "
+            "them to servlet/openapi.py ENDPOINT_INFO")
+
+    components: Dict[str, Dict] = {"Error": ERROR_SCHEMA,
+                                   "AsyncProgress": PROGRESS_SCHEMA}
+    paths: Dict[str, Dict] = {}
+    for endpoint, (summary, params, role) in sorted(ENDPOINT_INFO.items()):
+        method = "get" if endpoint in GET_ENDPOINTS else "post"
+        cname = _component_name(endpoint)
+        components.setdefault(cname, schemas.ENDPOINT_SCHEMAS[endpoint])
+        ref = {"$ref": f"#/components/schemas/{cname}"}
+        responses = {
+            "200": {"description": "success",
+                    "content": {"application/json": {"schema": ref}}},
+            "400": {"description": "client error",
+                    "content": {"application/json": {"schema":
+                                {"$ref": "#/components/schemas/Error"}}}},
+        }
+        if method == "post" or endpoint in ("proposals",):
+            # Long-running operations return 202 + User-Task-ID until done
+            # (async servlet machinery; poll with the same header).
+            responses["202"] = {
+                "description": "operation in progress; poll with the "
+                               "returned User-Task-ID header",
+                "content": {"application/json": {"schema":
+                            {"$ref": "#/components/schemas/AsyncProgress"}}}}
+        paths[f"{API_PREFIX}/{endpoint}"] = {method: {
+            "operationId": endpoint,
+            "summary": summary,
+            "description": f"Minimum role: {role}.",
+            "parameters": [
+                {"name": n, "in": "query", "required": False,
+                 "description": d, "schema": {"type": t}}
+                for n, t, d in params
+            ],
+            "responses": responses,
+        }}
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "cruise-control-tpu REST API",
+            "description": "Generated from servlet/openapi.py — do not edit "
+                           "docs/openapi.yaml by hand; run "
+                           "scripts/gen_openapi.py.",
+            "version": "1",
+        },
+        "paths": paths,
+        "components": {"schemas": components},
+    }
+
+
+def render_yaml() -> str:
+    import yaml
+
+    return yaml.safe_dump(build_spec(), sort_keys=False,
+                          default_flow_style=False, width=79)

@@ -199,12 +199,77 @@ KAFKA_CLUSTER_STATE_SCHEMA = {
     },
 }
 
+#: Plain acknowledgement bodies (bootstrap, sampling toggles, stop).
+MESSAGE_SCHEMA = {
+    "type": "object",
+    "required": ["message"],
+    "properties": {"message": {"type": "string"}},
+}
+
+TRAIN_SCHEMA = {
+    "type": "object",
+    "required": ["message", "coefficients"],
+    "properties": {
+        "message": {"type": "string"},
+        "coefficients": {"type": ["array", "null"],
+                         "items": {"type": "number"}},
+    },
+}
+
+_REVIEW_ROW = {
+    "type": "object",
+    "required": ["Id", "EndPoint", "Status"],
+    "properties": {
+        "Id": {"type": "integer"},
+        "EndPoint": {"type": "string"},
+        "Query": {"type": "string"},
+        "Submitter": {"type": "string"},
+        "Status": {"type": "string"},
+        "Reason": {"type": "string"},
+    },
+}
+
+REVIEW_BOARD_SCHEMA = {
+    "type": "object",
+    "required": ["RequestInfo"],
+    "properties": {"RequestInfo": {"type": "array", "items": _REVIEW_ROW}},
+}
+
+ADMIN_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "selfHealingEnabledBefore": {"type": "object"},
+        "concurrency": {"type": "integer"},
+        "message": {"type": "string"},
+    },
+}
+
+METRICS_JSON_SCHEMA = {
+    "type": "object",
+    "required": ["sensors"],
+    "properties": {"sensors": {"type": "object"}},
+}
+
 ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "state": STATE_SCHEMA,
     "load": LOAD_SCHEMA,
     "partition_load": PARTITION_LOAD_SCHEMA,
     "proposals": OPERATION_RESULT_SCHEMA,
     "rebalance": OPERATION_RESULT_SCHEMA,
+    "add_broker": OPERATION_RESULT_SCHEMA,
+    "remove_broker": OPERATION_RESULT_SCHEMA,
+    "demote_broker": OPERATION_RESULT_SCHEMA,
+    "fix_offline_replicas": OPERATION_RESULT_SCHEMA,
+    "topic_configuration": OPERATION_RESULT_SCHEMA,
     "user_tasks": USER_TASKS_SCHEMA,
     "kafka_cluster_state": KAFKA_CLUSTER_STATE_SCHEMA,
+    "bootstrap": MESSAGE_SCHEMA,
+    "train": TRAIN_SCHEMA,
+    "stop_proposal_execution": MESSAGE_SCHEMA,
+    "pause_sampling": MESSAGE_SCHEMA,
+    "resume_sampling": MESSAGE_SCHEMA,
+    "review_board": REVIEW_BOARD_SCHEMA,
+    "review": REVIEW_BOARD_SCHEMA,
+    "admin": ADMIN_SCHEMA,
+    "metrics": METRICS_JSON_SCHEMA,
 }

@@ -14,6 +14,21 @@ from __future__ import annotations
 from typing import Optional
 
 
+def read_secret_file(path: str, what: str = "secret") -> str:
+    """One canonical read-and-strip for every shared-secret file the
+    framework's faces consume (metrics bus, admin driver, maintenance bus,
+    simulator listener) — a missing or empty file fails with a clear error
+    instead of a raw traceback at assembly time."""
+    try:
+        with open(path) as f:
+            secret = f.read().strip()
+    except OSError as e:
+        raise ValueError(f"cannot read {what} file {path!r}: {e}") from e
+    if not secret:
+        raise ValueError(f"{what} file {path!r} is empty")
+    return secret
+
+
 def client_ssl_context(cafile: Optional[str] = None):
     """TLS context for a framework client connection.
 

@@ -1,0 +1,129 @@
+#!/usr/bin/env bash
+# Time the reference Java GoalOptimizer on the same configs bench.py times,
+# so the vs_java ratio can finally be computed — IN AN ENVIRONMENT WITH A
+# JDK.  This box has none (no `java`, no /usr/lib/jvm, zero egress; see
+# BASELINE.md "Java baseline status"), so this script is the ready-to-run
+# kit, not something that has ever produced a number here.
+#
+# Usage:   ./scripts/bench_java.sh [/path/to/reference-checkout]
+# Output:  one JSON line per config on stdout, same metric names as bench.py
+#          (configs #1 and #2/#3 — the rows directly comparable to the
+#          Python/TPU implementation's numbers).
+#
+# What it does:
+#   1. Drops a JUnit driver (original code, written below) into the
+#      reference's test tree.  The driver builds the SAME fixtures the
+#      reference's own tests use (DeterministicCluster.unbalanced / 200
+#      replicas harness, RandomCluster.generate at 200 brokers / 50K
+#      replicas) and times GoalOptimizer.optimizations — the exact call the
+#      proposal path drives (GoalOptimizer.java:123,168).
+#   2. Runs it via the gradle wrapper with the test JVM pinned to one
+#      warmup + five timed iterations, and prints min/median wall-clock.
+#
+# Compare the resulting numbers to the matching rows of BENCH_r*.json and
+# verify quality with the reference's own OptimizationVerifier if desired.
+set -euo pipefail
+
+REF="${1:-/root/reference}"
+command -v java >/dev/null || {
+    echo "no java binary on PATH — this script needs a JDK environment" >&2
+    exit 2
+}
+[ -x "$REF/gradlew" ] || {
+    echo "no gradle wrapper at $REF/gradlew" >&2
+    exit 2
+}
+
+DRIVER_DIR="$REF/cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/analyzer"
+DRIVER="$DRIVER_DIR/TpuBaselineBenchTest.java"
+
+cat > "$DRIVER" <<'JAVA'
+// Baseline timing driver for the cruise-control-tpu comparison.  Original
+// code: builds the reference's own test fixtures and times the production
+// GoalOptimizer.optimizations call.  Written by scripts/bench_java.sh;
+// delete after the run.
+package com.linkedin.kafka.cruisecontrol.analyzer;
+
+import com.codahale.metrics.MetricRegistry;
+import com.linkedin.kafka.cruisecontrol.common.ClusterProperty;
+import com.linkedin.kafka.cruisecontrol.common.DeterministicCluster;
+import com.linkedin.kafka.cruisecontrol.common.TestConstants;
+import com.linkedin.kafka.cruisecontrol.config.KafkaCruiseControlConfig;
+import com.linkedin.kafka.cruisecontrol.config.constants.AnalyzerConfig;
+import com.linkedin.kafka.cruisecontrol.config.constants.ExecutorConfig;
+import com.linkedin.kafka.cruisecontrol.config.constants.MonitorConfig;
+import com.linkedin.kafka.cruisecontrol.executor.Executor;
+import com.linkedin.kafka.cruisecontrol.model.ClusterModel;
+import com.linkedin.kafka.cruisecontrol.model.RandomCluster;
+import com.linkedin.kafka.cruisecontrol.monitor.LoadMonitor;
+import com.linkedin.kafka.cruisecontrol.async.progress.OperationProgress;
+import java.util.HashMap;
+import java.util.Map;
+import java.util.Properties;
+import org.apache.kafka.clients.admin.AdminClient;
+import org.apache.kafka.common.utils.SystemTime;
+import org.easymock.EasyMock;
+import org.junit.Test;
+
+public class TpuBaselineBenchTest {
+
+  private GoalOptimizer optimizer() {
+    Properties props = new Properties();
+    props.setProperty(MonitorConfig.BOOTSTRAP_SERVERS_CONFIG, "bootstrap.servers");
+    props.setProperty(ExecutorConfig.ZOOKEEPER_CONNECT_CONFIG, "connect:1234");
+    props.setProperty(AnalyzerConfig.NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG, "0");
+    props.setProperty(AnalyzerConfig.DEFAULT_GOALS_CONFIG, TestConstants.DEFAULT_GOALS_VALUES);
+    KafkaCruiseControlConfig config = new KafkaCruiseControlConfig(props);
+    return new GoalOptimizer(config, EasyMock.mock(LoadMonitor.class), new SystemTime(),
+                             new MetricRegistry(), EasyMock.mock(Executor.class),
+                             EasyMock.mock(AdminClient.class));
+  }
+
+  private void time(String metric, ClusterModelSupplier supplier) throws Exception {
+    GoalOptimizer opt = optimizer();
+    // Warmup (JIT) + 5 timed runs on FRESH models (optimizations mutates).
+    opt.optimizations(supplier.get(), new OperationProgress());
+    long best = Long.MAX_VALUE;
+    for (int i = 0; i < 5; i++) {
+      ClusterModel model = supplier.get();
+      long t0 = System.nanoTime();
+      opt.optimizations(model, new OperationProgress());
+      best = Math.min(best, System.nanoTime() - t0);
+    }
+    System.out.printf("{\"metric\": \"%s\", \"value\": %.4f, \"unit\": \"seconds\", \"impl\": \"java\"}%n",
+                      metric, best / 1e9);
+  }
+
+  interface ClusterModelSupplier { ClusterModel get() throws Exception; }
+
+  @Test
+  public void benchConfigs() throws Exception {
+    // Config #1: the DeterministicCluster harness (6 brokers / 3 racks).
+    time("proposal_generation_wall_clock_deterministic_6brokers_200replicas",
+         DeterministicCluster::unbalanced);
+
+    // Config #2/#3 shape: RandomCluster 200 brokers / 50K replicas.
+    Map<ClusterProperty, Number> properties = new HashMap<>(TestConstants.BASE_PROPERTIES);
+    properties.put(ClusterProperty.NUM_BROKERS, 200);
+    properties.put(ClusterProperty.NUM_RACKS, 10);
+    properties.put(ClusterProperty.NUM_REPLICAS, 50000);
+    properties.put(ClusterProperty.NUM_TOPICS, 1000);
+    time("proposal_generation_wall_clock_200brokers_50k_replicas_full_goals",
+         () -> {
+           ClusterModel model = RandomCluster.generate(properties);
+           RandomCluster.populate(model, properties, TestConstants.Distribution.UNIFORM);
+           return model;
+         });
+  }
+}
+JAVA
+
+cleanup() { rm -f "$DRIVER"; }
+trap cleanup EXIT
+
+cd "$REF"
+./gradlew :cruise-control:test --tests '*TpuBaselineBenchTest*' -i 2>&1 \
+  | grep -E '^\{"metric"' || {
+    echo "driver ran but emitted no metric lines — check gradle test output" >&2
+    exit 1
+}

@@ -1,0 +1,26 @@
+"""Regenerate docs/openapi.yaml from the live endpoint tables.
+
+The spec is built from servlet/openapi.py (parameter metadata) +
+servlet/schemas.py (response schemas) + servlet/server.py (endpoint sets),
+so it tracks the implementation; tests/test_servlet.py asserts the
+committed artifact matches this generator's output.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cruise_control_tpu.servlet.openapi import render_yaml
+
+
+def main() -> None:
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "openapi.yaml")
+    with open(out, "w") as f:
+        f.write(render_yaml())
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,12 +67,17 @@ def captured_metrics() -> set:
 
 
 def probe(timeout_s: float = 180.0) -> bool:
+    # Scrub a forced-CPU environment exactly like attempt() does — a daemon
+    # launched from a JAX_PLATFORMS=cpu shell must still SEE the TPU, or it
+    # reports the tunnel dead forever and never captures anything.
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
     try:
         p = subprocess.run(
             [sys.executable, "-c",
              "import jax, sys; d = jax.devices(); "
              "sys.exit(0 if d and d[0].platform != 'cpu' else 1)"],
-            timeout=timeout_s, capture_output=True)
+            timeout=timeout_s, capture_output=True, env=env)
         return p.returncode == 0
     except (subprocess.TimeoutExpired, OSError):
         return False

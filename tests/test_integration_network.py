@@ -1,0 +1,170 @@
+"""The full loop across EVERY network face at once.
+
+One test: a logdir failure injected into the out-of-process broker
+simulator → the assembled service's disk-failure detector reads it over the
+authenticated admin SOCKET → self-healing runs fix_offline_replicas on a
+model that marks those replicas offline → the executor's moves ride the
+same socket back to the simulator → while broker metrics keep flowing over
+the authenticated TCP metrics bus.  Reference analog:
+``BrokerFailureDetectorTest`` + ``ExecutorTest`` against embedded brokers —
+here every hop crosses a real process/socket boundary.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+GOALS = "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,ReplicaDistributionGoal"
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _release(backend):
+    """Drop a SocketClusterBackend's connection WITHOUT the shutdown op
+    (close() would stop the simulator for everyone)."""
+    backend._rstream.close()
+    backend._wstream.close()
+    backend._sock.close()
+
+
+def _get_state(port):
+    url = f"http://127.0.0.1:{port}/kafkacruisecontrol/state"
+    return json.load(urllib.request.urlopen(url, timeout=10))
+
+
+def test_disk_failure_self_heals_across_all_network_faces(tmp_path):
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.subprocess_backend import (
+        SocketClusterBackend,
+    )
+    from cruise_control_tpu.main import build_app, demo_metadata
+    from cruise_control_tpu.reporter import SocketTransport
+
+    admin_token = tmp_path / "admin.secret"
+    admin_token.write_text("integration-admin-token\n")
+    bus_secret = tmp_path / "bus.secret"
+    bus_secret.write_text("integration-bus-secret\n")
+
+    # --- out-of-process cluster: the broker simulator on a TCP listener,
+    # bootstrapped to EXACTLY the demo metadata topology (6 brokers, 48
+    # demo-topic partitions, rf=2) so executor tasks apply cleanly.
+    sim = subprocess.Popen(
+        [sys.executable, "-m", "cruise_control_tpu.executor.broker_simulator",
+         "--listen", "0", "--polls-to-finish", "1",
+         "--auth-token-file", str(admin_token)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        banner = json.loads(sim.stdout.readline())
+        sim_port = int(banner["listening"])
+        meta = demo_metadata()
+        parts = [{"topic": p.topic, "partition": p.partition,
+                  "replicas": list(p.replicas), "leader": p.leader,
+                  "logdirs": {str(b): 0 for b in p.replicas}}
+                 for p in meta.fetch().partitions]
+        setup = SocketClusterBackend("127.0.0.1", sim_port,
+                                     auth_secret="integration-admin-token")
+        setup.request("bootstrap", partitions=parts)
+        _release(setup)
+
+        # --- the assembled service: reporter-mode sampling, TCP metrics bus
+        # (authenticated), socket admin driver (authenticated), self-healing
+        # on a short detection interval, restricted goal list to keep the
+        # self-healing solve's compile bounded on the test box.
+        bus_port = _free_port()
+        config = CruiseControlConfig({
+            "metric.sampler.mode": "reporter",
+            "metrics.transport.listen.port": str(bus_port),
+            "metrics.transport.auth.secret.file": str(bus_secret),
+            "executor.admin.backend.address": f"127.0.0.1:{sim_port}",
+            "executor.admin.backend.auth.secret.file": str(admin_token),
+            "self.healing.enabled": "true",
+            "anomaly.detection.interval.ms": "1500",
+            "execution.progress.check.interval.ms": "200",
+            "partition.metrics.window.ms": "400",
+            "broker.metrics.window.ms": "400",
+            "metric.sampling.interval.ms": "150",
+            "min.samples.per.partition.metrics.window": "1",
+            "proposal.expiration.ms": "0",      # no precompute daemon noise
+            "default.goals": GOALS,
+            "anomaly.detection.goals": GOALS,
+        })
+        app = build_app(config, port=0)
+        app.cc.start_up()
+        app.start()
+        try:
+            # --- metrics flow over the authenticated TCP bus (the network
+            # face remote reporter agents use).
+            bus = SocketTransport(f"127.0.0.1:{bus_port}",
+                                  auth_secret="integration-bus-secret")
+            deadline = time.time() + 60
+            seen = 0
+            while time.time() < deadline and not seen:
+                seen = sum(len(bus.poll(p, 0, 10)[0])
+                           for p in range(bus.num_partitions))
+                time.sleep(1)
+            assert seen > 0, "no metrics crossed the TCP bus"
+            bus.close()
+
+            # --- monitor forms windows from the reporter pipeline.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if _get_state(app.port)["MonitorState"]["numValidWindows"] >= 2:
+                    break
+                time.sleep(2)
+            else:
+                raise AssertionError("monitor never formed valid windows")
+
+            # --- inject the failure in the SIMULATOR process, mid-run, over
+            # a second authenticated admin connection.
+            injector = SocketClusterBackend(
+                "127.0.0.1", sim_port, auth_secret="integration-admin-token")
+            injector.request("fail_logdir", broker=0, logdir=0)
+            assert injector.request("describe_log_dirs")["offline"] == {"0": [0]}
+            _release(injector)
+
+            # --- detector (over the admin socket) → self-healing fix →
+            # executor moves (over the same socket).  The fix evacuates
+            # broker 0's dead logdir: eventually no demo-topic partition
+            # keeps a replica on broker 0.
+            deadline = time.time() + 900
+            fix_started = False
+            evacuated = False
+            while time.time() < deadline and not evacuated:
+                ad = _get_state(app.port)["AnomalyDetectorState"]
+                rows = [a for v in ad.get("recentAnomalies", {}).values()
+                        for a in v]
+                fix_started = fix_started or any(
+                    a.get("type") == "DISK_FAILURE"
+                    and a.get("status") in ("FIX_STARTED", "FIX_FAILED_TO_START")
+                    for a in rows)
+                checker = SocketClusterBackend(
+                    "127.0.0.1", sim_port,
+                    auth_secret="integration-admin-token")
+                final = checker.request("describe_topics")["partitions"]
+                _release(checker)
+                evacuated = all(0 not in d["replicas"] for d in final)
+                time.sleep(3)
+            assert fix_started, "disk failure was never routed to the fixer"
+            assert evacuated, \
+                "broker 0's replicas were not evacuated over the admin socket"
+
+            # --- the metrics bus face survived the whole loop.
+            bus2 = SocketTransport(f"127.0.0.1:{bus_port}",
+                                   auth_secret="integration-bus-secret")
+            assert bus2.num_partitions > 0
+            bus2.close()
+        finally:
+            app.stop()
+            app.cc.shutdown()
+    finally:
+        sim.kill()

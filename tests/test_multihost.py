@@ -95,3 +95,128 @@ def test_two_process_global_mesh_propose(tmp_path):
     assert r0["n_proposals"] == r1["n_proposals"] > 0
     assert r0["digest_hash"] == r1["digest_hash"]
     assert r0["digest"] == r1["digest"]
+
+
+_CHILD_WHATIF = textwrap.dedent("""
+    import hashlib, json, sys
+    import numpy as np
+    sys.path.insert(0, __REPO__)
+    from cruise_control_tpu.utils.hermetic import force_cpu
+    force_cpu(n_devices=4)
+    import jax
+    pid = int(sys.argv[1])
+    from cruise_control_tpu.parallel import multihost
+    multihost.initialize(__ADDR__, num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 8
+
+    from cruise_control_tpu.testing import random_cluster as rc
+    props = rc.ClusterProperties(num_brokers=8, num_racks=4, num_topics=10,
+                                 num_replicas=192, mean_cpu=0.01,
+                                 mean_disk=60.0, mean_nw_in=60.0,
+                                 mean_nw_out=60.0, seed=11)
+    state, placement, meta = rc.generate(props, pad_replicas_to=256)
+    if pid == 1:
+        import jax.numpy as jnp
+        placement = placement.replace(
+            broker=jnp.zeros_like(placement.broker))   # garbage content
+    res = multihost.batch_remove_scenarios_multihost(
+        state, placement, meta, [[0], [1], [2], [3]],
+        goal_names=["RackAwareGoal", "ReplicaCapacityGoal"],
+        scenario_parallelism=2, num_candidates=64)
+    payload = {
+        "pid": pid,
+        "violated": np.asarray(res.violated_after).tolist(),
+        "stranded": int(np.asarray(res.stranded_after).sum()),
+        "placements_hash": hashlib.sha256(
+            np.asarray(res.final_placements.broker).tobytes()).hexdigest(),
+    }
+    print("RESULT " + json.dumps(payload), flush=True)
+""")
+
+
+def test_two_process_scenario_mesh_what_ifs(tmp_path):
+    """The DP x MP analog across REAL processes: the remove-broker what-if
+    batch shards its scenario axis over two coordinated processes (replica
+    axis within), and both return bit-identical lane results."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_whatif.py"
+    script.write_text(_CHILD_WHATIF.replace("__REPO__", repr(repo))
+                      .replace("__ADDR__", repr(addr)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for pid in (0, 1)]
+    outs = [p.communicate(timeout=840)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, out[-3000:]
+        r = json.loads(line[-1][len("RESULT "):])
+        results[r["pid"]] = r
+    r0, r1 = results[0], results[1]
+    assert r0["stranded"] == r1["stranded"] == 0
+    assert r0["violated"] == r1["violated"]
+    assert all(v == 0 for lane in r0["violated"] for v in lane)
+    assert r0["placements_hash"] == r1["placements_hash"]
+
+
+_CHILD_DEATH = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, __REPO__)
+    from cruise_control_tpu.utils.hermetic import force_cpu
+    force_cpu(n_devices=4)
+    import jax
+    pid = int(sys.argv[1])
+    from cruise_control_tpu.parallel import multihost
+    # Tight heartbeat so failure detection is test-sized (production keeps
+    # the default; the knob is the point).
+    multihost.initialize(__ADDR__, num_processes=2, process_id=pid,
+                         heartbeat_timeout_s=10)
+    print(f"pid{pid} up", flush=True)
+    if pid == 1:
+        os._exit(17)          # die abruptly before the collective
+    import jax.numpy as jnp
+    # The survivor enters the broadcast that now can never complete.
+    out = multihost.broadcast_from_coordinator(jnp.arange(8.0))
+    print("pid0 unexpectedly completed", flush=True)
+""")
+
+
+def test_worker_death_terminates_survivor_crisply(tmp_path):
+    """A peer killed mid-solve must NOT leave the survivor hanging in the
+    orphaned collective: the coordination service's heartbeat timeout
+    (multihost.initialize(heartbeat_timeout_s=...)) terminates it with an
+    'unhealthy tasks' diagnosis — the SPMD analog of the reference's ZK
+    session-loss handling (BrokerFailureDetector.java:64-92)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_death.py"
+    script.write_text(_CHILD_DEATH.replace("__REPO__", repr(repo))
+                      .replace("__ADDR__", repr(addr)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for pid in (0, 1)]
+    out1, _ = procs[1].communicate(timeout=300)
+    assert procs[1].returncode == 17          # the scripted abrupt death
+    # Survivor must exit (non-zero) well before the test timeout, with the
+    # coordination service's diagnosis on its stderr — not hang.
+    out0, _ = procs[0].communicate(timeout=240)
+    assert procs[0].returncode != 0, out0[-2000:]
+    assert "pid0 unexpectedly completed" not in out0
+    assert ("unhealthy" in out0 or "heartbeat" in out0
+            or "distributed service detected fatal errors" in out0), \
+        out0[-3000:]

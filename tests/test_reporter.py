@@ -428,3 +428,26 @@ def test_transport_server_tls(tmp_path):
             plain.append(0, b"plaintext")
     finally:
         server.stop()
+
+
+def test_transport_server_preauth_garbage_disconnects():
+    """Unparseable pre-auth frames must disconnect, not loop as per-frame
+    errors — an unauthenticated peer may not pin a server thread."""
+    import socket
+
+    from cruise_control_tpu.reporter import InProcessTransport, TransportServer
+
+    server = TransportServer(InProcessTransport(num_partitions=1),
+                             auth_secret="s")
+    server.start()
+    try:
+        import json as _json
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=10) as s:
+            s.sendall(b"not json at all\n")
+            f = s.makefile("rb")
+            resp = _json.loads(f.readline())
+            assert resp["ok"] is False and "auth" in resp["error"]
+            assert f.readline() == b""           # disconnected
+    finally:
+        server.stop()

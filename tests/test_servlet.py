@@ -478,3 +478,103 @@ def test_get_configured_instance_config_passing():
     assert get_configured_instance("Declared", reg, config=cfg).config is cfg
     assert get_configured_instance("CatchAll", reg, config=cfg).config is cfg
     assert get_configured_instance("Bare", reg, config=cfg) is not None
+
+
+# ----------------------------------------------------------------- OpenAPI
+
+
+def test_openapi_artifact_current_and_complete():
+    """docs/openapi.yaml is generated (scripts/gen_openapi.py) and must match
+    the live endpoint tables — the reference ships src/yaml/endpoints/* and
+    ResponseTest validates against it; here drift fails the build."""
+    import os
+
+    from cruise_control_tpu.servlet.openapi import API_PREFIX, build_spec, render_yaml
+    from cruise_control_tpu.servlet.server import GET_ENDPOINTS, POST_ENDPOINTS
+
+    spec = build_spec()
+    for endpoint in GET_ENDPOINTS | POST_ENDPOINTS:
+        path = f"{API_PREFIX}/{endpoint}"
+        assert path in spec["paths"], f"endpoint {endpoint} missing from spec"
+        method = "get" if endpoint in GET_ENDPOINTS else "post"
+        op = spec["paths"][path][method]
+        ref = op["responses"]["200"]["content"]["application/json"]["schema"]
+        cname = ref["$ref"].rsplit("/", 1)[-1]
+        assert cname in spec["components"]["schemas"]
+
+    artifact = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "openapi.yaml")
+    with open(artifact) as f:
+        committed = f.read()
+    assert committed == render_yaml(), \
+        "docs/openapi.yaml is stale — run scripts/gen_openapi.py"
+
+    # The committed YAML parses and round-trips to the same document.
+    import yaml
+    assert yaml.safe_load(committed) == spec
+
+
+def test_ack_endpoints_match_schemas(app):
+    """Response-schema checks for the small endpoints the heavier tests
+    don't cover (the solving endpoints are validated where they already run:
+    rebalance in test_security_schemas, review flow above)."""
+    from cruise_control_tpu.servlet.schemas import ENDPOINT_SCHEMAS, validate
+
+    status, body, _ = _get(app, "bootstrap", start="0", end="1")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["bootstrap"])
+
+    status, body, _ = _get(app, "train", start="0", end="1e15")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["train"])
+
+    status, body, _ = _get(app, "metrics", json="true")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["metrics"])
+
+    status, body, _ = _post(app, "pause_sampling", reason="schema-check")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["pause_sampling"])
+    status, body, _ = _post(app, "resume_sampling", reason="schema-check")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["resume_sampling"])
+
+    status, body, _ = _post(app, "stop_proposal_execution")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["stop_proposal_execution"])
+
+    status, body, _ = _post(app, "admin",
+                            enable_self_healing_for="broker_failure")
+    assert status == 200
+    validate(body, ENDPOINT_SCHEMAS["admin"])
+
+
+def test_solving_endpoints_match_operation_schema(app):
+    """Every async solving endpoint's completed body is a valid
+    OptimizationResult (the shared response schema in docs/openapi.yaml).
+    Runs AFTER the rebalance roundtrip in this module, so the goal-stack
+    compiles are already cached — each call here is a warm solve."""
+    from cruise_control_tpu.servlet.schemas import ENDPOINT_SCHEMAS, validate
+
+    def poll_done(endpoint, **params):
+        deadline = time.time() + 150
+        task_id = None
+        while time.time() < deadline:
+            headers = {USER_TASK_HEADER: task_id} if task_id else {}
+            status, body, hdrs = _post(app, endpoint, headers=headers, **params)
+            task_id = hdrs.get(USER_TASK_HEADER, task_id)
+            if status == 200 and "progress" not in body:
+                return body
+            time.sleep(0.3)
+        raise AssertionError(f"{endpoint} never completed")
+
+    for endpoint, params in (
+        ("remove_broker", {"brokerid": "3", "dryrun": "true"}),
+        ("add_broker", {"brokerid": "3", "dryrun": "true"}),
+        ("fix_offline_replicas", {"dryrun": "true"}),
+        ("demote_broker", {"brokerid": "1", "dryrun": "true"}),
+        ("topic_configuration", {"topic": ".*", "replication_factor": "2",
+                                 "dryrun": "true"}),
+    ):
+        body = poll_done(endpoint, **params)
+        validate(body, ENDPOINT_SCHEMAS[endpoint])
