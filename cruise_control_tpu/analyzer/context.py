@@ -33,6 +33,7 @@ from cruise_control_tpu.common.resources import (
     Resource,
 )
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
+from cruise_control_tpu.ops import broker_channel_sums, pallas_aggregates_enabled
 
 NEG_INF = -jnp.inf
 
@@ -219,12 +220,40 @@ def compute_aggregates(gctx: GoalContext, placement: Placement) -> Aggregates:
     t = gctx.num_topics
     load = jnp.where(placement.is_leader[:, None], state.leader_load, state.follower_load)
     load = load * state.valid[:, None]
-    broker_load = jax.ops.segment_sum(load, placement.broker, num_segments=b)
-    host_load = jax.ops.segment_sum(broker_load, state.host, num_segments=gctx.num_hosts)
     valid_i = state.valid.astype(jnp.int32)
     leader_i = (state.valid & placement.is_leader).astype(jnp.int32)
-    replica_counts = jax.ops.segment_sum(valid_i, placement.broker, num_segments=b)
-    leader_counts = jax.ops.segment_sum(leader_i, placement.broker, num_segments=b)
+    if pallas_aggregates_enabled():
+        # TPU kernel path (ops/pallas_aggregate.py): all eight broker-axis
+        # channels reduced in ONE pass over the replica stream — one-hot
+        # MXU matmuls into a VMEM accumulator instead of XLA's sort-based
+        # scatter.  Channel order: 4 resources, valid, leader, potential
+        # NW-out, leader bytes-in.
+        channels = jnp.concatenate([
+            load,
+            valid_i[:, None].astype(jnp.float32),
+            leader_i[:, None].astype(jnp.float32),
+            (state.leader_load[:, Resource.NW_OUT] * state.valid)[:, None],
+            (state.leader_load[:, Resource.NW_IN]
+             * leader_i.astype(jnp.float32))[:, None],
+        ], axis=1)
+        sums = broker_channel_sums(channels, placement.broker, b)
+        broker_load = sums[:, :4]
+        # Counts are exact in f32 up to 2^24 — far beyond padded R.
+        replica_counts = sums[:, 4].astype(jnp.int32)
+        leader_counts = sums[:, 5].astype(jnp.int32)
+        potential = sums[:, 6]
+        leader_bytes_in = sums[:, 7]
+    else:
+        broker_load = jax.ops.segment_sum(load, placement.broker, num_segments=b)
+        replica_counts = jax.ops.segment_sum(valid_i, placement.broker, num_segments=b)
+        leader_counts = jax.ops.segment_sum(leader_i, placement.broker, num_segments=b)
+        potential = jax.ops.segment_sum(
+            state.leader_load[:, Resource.NW_OUT] * state.valid,
+            placement.broker, num_segments=b)
+        leader_bytes_in = jax.ops.segment_sum(
+            state.leader_load[:, Resource.NW_IN] * leader_i.astype(jnp.float32),
+            placement.broker, num_segments=b)
+    host_load = jax.ops.segment_sum(broker_load, state.host, num_segments=gctx.num_hosts)
     flat = state.topic * b + placement.broker
     topic_counts = jax.ops.segment_sum(valid_i, flat, num_segments=t * b).reshape(t, b)
     topic_leader_counts = jax.ops.segment_sum(leader_i, flat, num_segments=t * b).reshape(t, b)
@@ -233,11 +262,6 @@ def compute_aggregates(gctx: GoalContext, placement: Placement) -> Aggregates:
         load[:, Resource.DISK], dflat,
         num_segments=b * state.num_disks_per_broker,
     ).reshape(b, state.num_disks_per_broker)
-    potential = jax.ops.segment_sum(
-        state.leader_load[:, Resource.NW_OUT] * state.valid, placement.broker, num_segments=b)
-    leader_bytes_in = jax.ops.segment_sum(
-        state.leader_load[:, Resource.NW_IN] * leader_i.astype(jnp.float32),
-        placement.broker, num_segments=b)
     return Aggregates(
         broker_load=broker_load, host_load=host_load,
         replica_counts=replica_counts, leader_counts=leader_counts,

@@ -1,0 +1,15 @@
+"""Low-level TPU kernels (Pallas) with XLA fallbacks.
+
+The compute path of the framework is plain JAX/XLA almost everywhere —
+XLA's fusion is the right tool for the solver's tiles.  This package holds
+the few ops where a hand-written TPU kernel beats what XLA emits, each with
+a same-signature XLA fallback selected automatically off-TPU (and usable
+for differential testing via interpret mode).
+"""
+
+from cruise_control_tpu.ops.pallas_aggregate import (
+    broker_channel_sums,
+    pallas_aggregates_enabled,
+)
+
+__all__ = ["broker_channel_sums", "pallas_aggregates_enabled"]

@@ -1,0 +1,156 @@
+"""Pallas TPU kernel for the aggregate-recompute hot path.
+
+``analyzer/context.compute_aggregates`` reduces every replica's load vector
+onto its broker — eight independent channels (4 resources, replica/leader
+counts, potential NW-out, leader bytes-in) summed by broker id over the
+[R]-long replica axis, at every round boundary and aggregate resync.  XLA
+lowers ``jax.ops.segment_sum`` on TPU to a sort-based scatter over HBM;
+this kernel instead streams replica chunks through VMEM once and builds the
+whole [channels, B] result with one-hot MXU matmuls into a VMEM-resident
+accumulator:
+
+- grid over replica chunks (TPU grid steps run sequentially, so the output
+  block — revisited by every step — accumulates without atomics);
+- per chunk: ``onehot[c, b] = (broker[c] == b)`` via ``broadcasted_iota``
+  compare, then ``channels.T @ onehot`` on the MXU ([K, CHUNK] @
+  [CHUNK, B]);
+- the broker axis rides the lane dimension (padded to 128) so the [K, B]
+  accumulator tiles cleanly; K=8 channels sit on sublanes.
+
+Traffic: the replica data crosses HBM exactly once (4 + 4 bytes per
+replica per channel-group) and the accumulator never leaves VMEM —
+~2600 × 128 × 4 B ≈ 1.3 MB at north-star scale.
+
+The same function runs everywhere: off-TPU it falls back to
+``segment_sum`` with identical semantics — chosen by an explicit backend
+check at trace time, because under an outer jit a Mosaic lowering error
+surfaces at COMPILE time where no try/except here could catch it — and
+tests drive the kernel in interpret mode against that fallback.  NOTE: the
+kernel has only ever executed in interpret mode in this environment (the
+TPU tunnel was down for the whole round) — the lowering is written to the
+TPU tiling rules but is gated OFF by default until a real-chip run
+validates it (`CC_PALLAS_AGG=1` opts in; see pallas_aggregates_enabled).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+#: Replicas per grid step.  512×(B padded to 128-multiples) one-hot tiles:
+#: 512 × 2688 × 4 B ≈ 5.5 MB VMEM at north-star scale — inside the ~16 MB
+#: budget with the accumulator and channel blocks.
+CHUNK = 512
+
+
+def pallas_aggregates_enabled() -> bool:
+    """Kernel gate: CC_PALLAS_AGG=1 forces on, =0 forces off; default OFF
+    (the kernel is untested on real TPU hardware in this environment — flip
+    the default after a validated on-chip run)."""
+    flag = os.environ.get("CC_PALLAS_AGG", "")
+    if flag == "1":
+        return True
+    return False
+
+
+def _kernel_impl(pl, ch_ref, broker_ref, out_ref):
+    """One replica chunk: out[K, B] += channels[K, CHUNK] @ onehot[CHUNK, B]."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    chunk = broker_ref.shape[1]
+    b = out_ref.shape[1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (chunk, b), 1)
+    onehot = (broker_ref[0, :, None] == cols).astype(jnp.float32)
+    out_ref[:] += jnp.dot(ch_ref[:], onehot,
+                          preferred_element_type=jnp.float32)
+
+
+def _pallas_sums(channels_t: jnp.ndarray, broker2d: jnp.ndarray,
+                 b_pad: int, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    k, r = channels_t.shape
+    grid = r // CHUNK
+    return pl.pallas_call(
+        partial(_kernel_impl, pl),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((k, CHUNK), lambda i: (0, i)),
+            pl.BlockSpec((1, CHUNK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k, b_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, b_pad), jnp.float32),
+        interpret=interpret,
+    )(channels_t, broker2d)
+
+
+def broker_channel_sums(channels: jnp.ndarray, broker: jnp.ndarray,
+                        num_segments: int, *,
+                        prefer_pallas: bool | None = None,
+                        interpret: bool = False) -> jnp.ndarray:
+    """f32[num_segments, K]: per-segment sums of ``channels`` ([R, K]) by
+    ``broker`` ([R] int32, values in [0, num_segments)).
+
+    Semantics are exactly ``jax.ops.segment_sum(channels, broker,
+    num_segments)``; the Pallas path additionally requires padded/invalid
+    rows to carry ZERO channels (the solver's ``state.valid`` masking
+    already guarantees this — padded rows point at broker 0 with no load).
+    ``prefer_pallas=None`` consults :func:`pallas_aggregates_enabled`; any
+    trace-time Pallas failure (unsupported transform, non-TPU lowering)
+    falls back to the XLA path.
+    """
+    if prefer_pallas is None:
+        prefer_pallas = pallas_aggregates_enabled()
+    if not interpret:
+        # Backend eligibility is decided HERE, at trace time, with a plain
+        # Python check — NOT by catching lowering errors: under an outer jit
+        # (every production solve) pallas_call binds fine at trace and the
+        # Mosaic lowering failure would only surface during the outer jit's
+        # COMPILE, far outside any try block in this function.
+        if not prefer_pallas or jax.default_backend() != "tpu":
+            if prefer_pallas:
+                _warn_fallback_once(
+                    f"backend {jax.default_backend()!r} is not tpu")
+            return jax.ops.segment_sum(channels, broker,
+                                       num_segments=num_segments)
+    r, k = channels.shape
+    r_pad = -(-r // CHUNK) * CHUNK
+    b_pad = -(-max(num_segments, 1) // 128) * 128
+    ch = channels.astype(jnp.float32)
+    br = broker.astype(jnp.int32)
+    if r_pad != r:
+        ch = jnp.pad(ch, ((0, r_pad - r), (0, 0)))
+        # Padded rows: broker -1 matches no one-hot column.
+        br = jnp.pad(br, (0, r_pad - r), constant_values=-1)
+    try:
+        out = _pallas_sums(ch.T, br.reshape(1, r_pad), b_pad,
+                           interpret=interpret)
+    except Exception as e:   # noqa: BLE001 — trace-time batching/API gaps
+        # Trace-time failures only (e.g. an unsupported transform): compile-
+        # time Mosaic errors cannot reach this handler — see above.
+        _warn_fallback_once(f"{type(e).__name__}: {e}")
+        return jax.ops.segment_sum(channels, broker,
+                                   num_segments=num_segments)
+    return out[:, :num_segments].T.astype(channels.dtype)
+
+
+_warned = False
+
+
+def _warn_fallback_once(why: str) -> None:
+    """A silently-ignored CC_PALLAS_AGG=1 would make 'kernel on' benchmarks
+    quietly measure the fallback; say so once."""
+    global _warned
+    if not _warned:
+        _warned = True
+        import logging
+        logging.getLogger(__name__).warning(
+            "pallas aggregate kernel requested but falling back to "
+            "segment_sum: %s", why)
