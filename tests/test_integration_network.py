@@ -168,3 +168,55 @@ def test_disk_failure_self_heals_across_all_network_faces(tmp_path):
             app.cc.shutdown()
     finally:
         sim.kill()
+
+
+def test_maintenance_plans_over_authed_tcp_through_assembled_service(tmp_path):
+    """The address-mode maintenance stream end-to-end: the assembled service
+    consumes plans from an AUTHENTICATED TransportServer over TCP (the
+    Kafka-topic analog with listener security), posted by a second client
+    connection."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.detector.maintenance_reader import serialize_plan
+    from cruise_control_tpu.main import build_app
+    from cruise_control_tpu.reporter import (
+        InProcessTransport,
+        SocketTransport,
+        TransportServer,
+    )
+
+    secret = tmp_path / "maint.secret"
+    secret.write_text("maint-secret\n")
+    bus = TransportServer(InProcessTransport(num_partitions=4),
+                          auth_secret="maint-secret")
+    bus.start()
+    reader = None
+    try:
+        config = CruiseControlConfig({
+            "maintenance.event.transport.address": f"127.0.0.1:{bus.port}",
+            "maintenance.event.transport.auth.secret.file": str(secret),
+            "maintenance.event.offsets.path": str(tmp_path / "off.json"),
+            "self.healing.enabled": "true",
+        })
+        app = build_app(config, port=0)
+        reader = app.cc.maintenance_reader
+        assert reader is not None
+        # Producer side: a second authenticated client posts a plan.
+        producer = SocketTransport(f"127.0.0.1:{bus.port}",
+                                   auth_secret="maint-secret")
+        producer.append(2, serialize_plan("remove_broker",
+                                          time_ms=time.time() * 1000,
+                                          broker_ids=(3,)))
+        producer.close()
+        accepted, dropped = reader.poll_once()
+        assert (accepted, dropped) == (1, 0)
+        det = app.cc.anomaly_detector.detectors[AnomalyType.MAINTENANCE_EVENT]
+        events = det.detect()
+        assert len(events) == 1 and events[0].plan == "remove_broker"
+        assert events[0].broker_ids == (3,)
+    finally:
+        if reader is not None:
+            reader._transport.close()
+        bus.stop()
