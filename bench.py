@@ -14,11 +14,11 @@ All five BASELINE.md configs, one JSON line each (headline LAST):
   north-star scale (<10 s budget on one v5e chip).
 - config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
   vmapped scenario batch through the production
-  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack), in FOUR rows:
+  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack), in FIVE rows:
   the round-comparable lane batch (cold + warm), ONE scenario decommissioning
   64 brokers at once (the reference's RemoveBrokersRunnable removes a *set*
-  in one operation — BASELINE's literal shape), and the full 64-lane batch
-  even on the CPU fallback.
+  in one operation — BASELINE's literal shape; cold + warm), and the full
+  64-lane batch even on the CPU fallback.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
@@ -298,6 +298,16 @@ def run(backend: str, only=None) -> None:
         _emit("remove_64_brokers_single_scenario_2600brokers_1m_replicas_"
               "hard_goals", one_s, backend, brokers_removed=64, scenarios=1,
               includes_compile=True, compile_cache="cold")
+        # Warm repeat on a different 64-broker set: what a second
+        # decommission request at this size class pays.
+        t0 = time.monotonic()
+        opt_hard.batch_remove_scenarios(
+            h_state, h_placement, h_meta, [list(range(64, 128))],
+            num_candidates=512)
+        one_w = time.monotonic() - t0
+        _emit("remove_64_brokers_single_scenario_2600brokers_1m_replicas_"
+              "hard_goals_warm", one_w, backend, brokers_removed=64,
+              scenarios=1, includes_compile=False, compile_cache="warm")
 
         # The full 64-lane what-if batch, run even on CPU (once, slow is
         # fine) so a number at BASELINE's exact lane count exists.  Guarded:
