@@ -10,29 +10,37 @@ All five BASELINE.md configs, one JSON line each (headline LAST):
   ``RandomClusterTest``).
 - config #3 (headline): RandomCluster 200 brokers / 50K replicas, full
   hard-goal stack + distribution soft goals — comparable across rounds.
-- config #4: 2.6K brokers / 1M replicas, full default goal stack — the
-  north-star scale (<10 s budget on one v5e chip).
+- config #4: 2.6K brokers / 1M replicas, the FULL default goal stack (all
+  15 registry goals) — the north-star scale (<10 s budget on one v5e chip).
 - config #5: remove-broker what-ifs at 2.6K brokers / 1M replicas as a
   vmapped scenario batch through the production
-  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack), in FIVE rows:
-  the round-comparable lane batch (cold + warm), ONE scenario decommissioning
+  ``GoalOptimizer.batch_remove_scenarios`` (hard-goal stack): the
+  round-comparable lane batch (cold + warm), ONE scenario decommissioning
   64 brokers at once (the reference's RemoveBrokersRunnable removes a *set*
   in one operation — BASELINE's literal shape; cold + warm), and the full
-  64-lane batch even on the CPU fallback.
+  64-lane batch (cold + warm) even on the CPU fallback — the compilesvc
+  lane-chunking planner routes 64 lanes through already-compiled widths,
+  so the first 64-lane call should pay (close to) zero fresh compiles.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
 BASELINE.md "Java baseline status"), so the Java GoalOptimizer has never
 been timed here — configs #1/#2 exist so the ratio can be computed the day
 a JVM is available, not to fake one now.
-Wall-clock excludes one warmup solve (jit compile is cached across snapshots
-of the same size class in production).
+
+Every row carries ``violated_after`` (violated-broker count summed over
+goals after optimization) and ``balancedness`` (hard=3.0/soft=1.0 weighted
+satisfied-goal score, [0,100]), plus ``fresh_compiles`` /
+``includes_compile`` / ``compile_cache`` derived from the compilesvc
+telemetry's compile counter around the timed region — the labels are
+measured, not asserted.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 NORTH_STAR_BUDGET_S = 10.0
@@ -55,6 +63,10 @@ def select_backend() -> str:
     force_cpu()
     return "cpu"
 
+# The FULL default stack, byte-for-byte ``goals.registry.DEFAULT_GOALS``
+# (tests/test_bench_goals.py asserts they cannot drift apart).  The first
+# six are the hard capacity/rack goals — HARD_GOALS below relies on that
+# registry ordering.
 GOALS = [
     "RackAwareGoal",
     "ReplicaCapacityGoal",
@@ -63,43 +75,66 @@ GOALS = [
     "NetworkOutboundCapacityGoal",
     "CpuCapacityGoal",
     "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
     "NetworkInboundUsageDistributionGoal",
     "NetworkOutboundUsageDistributionGoal",
     "CpuUsageDistributionGoal",
-    "DiskUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
     "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
 ]
 
+HARD_GOALS = GOALS[:6]
 
 TPU_CHILD_TIMEOUT_S = 1800.0
 
 
-def main() -> None:
-    import os
-    import subprocess
-    import sys
+def _parse_only(argv):
+    """``--only 3`` / ``--only 1,5`` → {3} / {1, 5}.  A missing or
+    non-numeric argument is a usage error, not a traceback."""
+    if "--only" not in argv:
+        return None
+    try:
+        raw = argv[argv.index("--only") + 1]
+        return {int(c) for c in raw.split(",")}
+    except (IndexError, ValueError):
+        sys.stderr.write("usage: bench.py [--only N[,N...]]  "
+                         "(config numbers 1-5, e.g. --only 3 or "
+                         "--only 1,5)\n")
+        raise SystemExit(2)
 
-    only = None
-    if "--only" in sys.argv:
-        # Run a subset of configs (e.g. ``--only 3`` for the smallest
-        # full-stack compile).  Used by scripts/tpu_capture.py to grab the
-        # cheapest TPU datapoint first while the flaky tunnel is alive.
-        only = {int(c) for c in
-                sys.argv[sys.argv.index("--only") + 1].split(",")}
+
+def main() -> None:
+    import subprocess
+
+    # Run a subset of configs (e.g. ``--only 3`` for the smallest
+    # full-stack compile).  Used by scripts/tpu_capture.py to grab the
+    # cheapest TPU datapoint first while the flaky tunnel is alive.
+    only = _parse_only(sys.argv)
 
     if "--tpu-child" in sys.argv:
         # Parent already probed the backend; just run.  Application errors
         # exit 3 (the parent fails loud instead of masking them with a CPU
         # rerun); backend/runtime deaths exit 4 (CPU fallback).
-        if os.environ.get("CC_TPU_PERSIST_CACHE"):
+        persist = os.environ.get("CC_TPU_PERSIST_CACHE")
+        if persist:
             # TPU executables are compiled server-side for the TPU — the
             # XLA:CPU "different machine features across processes" SIGILL
             # (tests/conftest.py) does not apply, and a persisted cache lets
             # a second tunnel-alive window skip straight to the bigger
             # configs.  Opt-in so the driver's own run stays hermetic.
-            from cruise_control_tpu.utils.hermetic import (
-                enable_persistent_compilation_cache)
-            enable_persistent_compilation_cache()
+            # Routed through the compilesvc manager: versioned key dirs,
+            # quarantine-on-corruption, eviction (a value other than a bare
+            # "1"/"true" flag names the cache root).
+            from cruise_control_tpu.compilesvc import compile_service
+            from cruise_control_tpu.compilesvc.service import goal_stack_hash
+            svc = compile_service()
+            svc.cache.enabled = True
+            if persist.lower() not in ("1", "true", "yes"):
+                svc.cache.root = persist
+            svc.cache.activate(platform_name="tpu",
+                               goal_stack_hash=goal_stack_hash(GOALS))
         try:
             run("tpu", only=only)
         except Exception as e:
@@ -139,9 +174,6 @@ def main() -> None:
     run("cpu", only=only)
 
 
-HARD_GOALS = GOALS[:6]
-
-
 def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
     """One JSON line; ``vs_baseline`` is ALWAYS budget/value (whole
     measurement) so the field stays comparable across metrics and rounds."""
@@ -155,11 +187,53 @@ def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
     }), flush=True)
 
 
-def _timed(fn) -> float:
-    fn()                      # warmup: populate per-goal jit caches
+def _compile_fields(fresh: int) -> dict:
+    """Row annotations derived from the measured compile-counter delta —
+    "cold"/"warm" reports what the timed region actually paid, so a first
+    call that rode the lane-chunk planner onto already-compiled widths is
+    honestly warm."""
+    return {"fresh_compiles": fresh, "includes_compile": fresh > 0,
+            "compile_cache": "cold" if fresh > 0 else "warm"}
+
+
+def _timed_once(fn):
+    """Time ONE call (compile included when it happens).  Returns
+    ``(seconds, result, fresh_compiles)`` — the compile count is the
+    compilesvc telemetry delta across the call."""
+    from cruise_control_tpu.compilesvc import telemetry
+    tel = telemetry()
+    before = tel.compile_count()
     t0 = time.monotonic()
+    out = fn()
+    return time.monotonic() - t0, out, tel.compile_count() - before
+
+
+def _timed(fn):
+    """Warmup once (populate per-goal jit caches), then time the second
+    call; same ``(seconds, result, fresh_compiles)`` shape as
+    ``_timed_once``."""
     fn()
-    return time.monotonic() - t0
+    return _timed_once(fn)
+
+
+def _quality(result) -> dict:
+    """violated_after/balancedness for a sequential ``OptimizerResult``:
+    violated-broker count summed over goals, and the optimizer's own
+    hard=3.0/soft=1.0 weighted score."""
+    return {
+        "violated_after": sum(int(g.violated_brokers_after)
+                              for g in result.goal_infos),
+        "balancedness": round(result.balancedness_score, 3),
+    }
+
+
+def _batch_quality(res) -> dict:
+    """violated_after/balancedness for a ``BatchScenarioResult`` row: the
+    batch total of violated brokers and the WORST lane's balancedness (one
+    bad lane must not hide behind a mean)."""
+    worst = min(res.balancedness(s) for s in range(res.num_scenarios))
+    return {"violated_after": int(res.violated_after.sum()),
+            "balancedness": round(worst, 3)}
 
 
 def run(backend: str, only=None) -> None:
@@ -170,8 +244,9 @@ def run(backend: str, only=None) -> None:
     # across processes and warns that loading mismatched AOT results "could
     # lead to execution errors such as SIGILL" — the benchmark artifact must
     # never die to a stale cache entry.  (scripts/profile_solve.py opts in;
-    # the TPU child opts in via CC_TPU_PERSIST_CACHE, where executables are
-    # TPU-targeted and the CPU feature skew is irrelevant.)
+    # the TPU child opts in via CC_TPU_PERSIST_CACHE, now routed through
+    # compilesvc.PersistentCompileCache, where executables are TPU-targeted
+    # and the CPU feature skew is irrelevant.)
     # "warm" below therefore always means the IN-PROCESS jit cache.
     want = lambda c: only is None or c in only
 
@@ -187,11 +262,12 @@ def run(backend: str, only=None) -> None:
         state, placement, meta = rc.generate(props)
     if want(3):
         optimizer = GoalOptimizer(goal_names=GOALS)
-        headline = _timed(
+        h_s, h_res, h_fresh = _timed(
             lambda: optimizer.optimizations(state, placement, meta))
+        headline = (h_s, {**_quality(h_res), **_compile_fields(h_fresh)})
         _emit("proposal_generation_wall_clock_200brokers_50k_replicas_"
-              "full_goals", headline, backend)
-        del optimizer
+              "full_goals", h_s, backend, **headline[1])
+        del optimizer, h_res
 
     # ---- config #1: DeterministicCluster harness (6 brokers / 3 racks /
     # ~200 replicas, default goals — BASELINE.md config #1).
@@ -210,22 +286,24 @@ def run(backend: str, only=None) -> None:
         d_state, d_placement, d_meta = cm.freeze(pad_replicas_to=256,
                                                  pad_brokers_to=8)
         opt_det = GoalOptimizer(goal_names=GOALS)
-        det_s = _timed(
+        det_s, det_res, det_fresh = _timed(
             lambda: opt_det.optimizations(d_state, d_placement, d_meta))
         _emit("proposal_generation_wall_clock_deterministic_6brokers_"
-              "200replicas", det_s, backend)
-        del d_state, d_placement, opt_det
+              "200replicas", det_s, backend, **_quality(det_res),
+              **_compile_fields(det_fresh))
+        del d_state, d_placement, opt_det, det_res
 
     # ---- config #2: 200 brokers / 50K replicas, ONE ResourceDistributionGoal
     # (reuses config #3's still-live snapshot and solver caches).
     if want(2):
         opt_single = GoalOptimizer(
             goal_names=["NetworkInboundUsageDistributionGoal"])
-        single_s = _timed(
+        single_s, single_res, single_fresh = _timed(
             lambda: opt_single.optimizations(state, placement, meta))
         _emit("proposal_generation_wall_clock_200brokers_50k_replicas_single_"
-              "resource_distribution_goal", single_s, backend)
-        del opt_single
+              "resource_distribution_goal", single_s, backend,
+              **_quality(single_res), **_compile_fields(single_fresh))
+        del opt_single, single_res
     del state, placement
 
     # ---- config #4 fixture: north-star scale (2.6K brokers / 1M replicas)
@@ -236,13 +314,14 @@ def run(backend: str, only=None) -> None:
             mean_nw_in=90.0, mean_nw_out=90.0, seed=3141)
         b_state, b_placement, b_meta = rc.generate(big)
 
-        # config #4: full default stack at north-star scale.
+        # config #4: full default stack (all 15 goals) at north-star scale.
         opt_big = GoalOptimizer(goal_names=GOALS)
-        elapsed = _timed(
+        elapsed, big_res, big_fresh = _timed(
             lambda: opt_big.optimizations(b_state, b_placement, b_meta))
         _emit("proposal_generation_wall_clock_2600brokers_1m_replicas_"
-              "full_goals", elapsed, backend)
-        del opt_big, b_state, b_placement
+              "full_goals", elapsed, backend, goals=len(GOALS),
+              **_quality(big_res), **_compile_fields(big_fresh))
+        del opt_big, b_state, b_placement, big_res
 
     # config #5: decommission what-ifs over a HEALTHY cluster (the realistic
     # remove_broker setting — lanes pay for evacuation, not a full repair),
@@ -258,10 +337,9 @@ def run(backend: str, only=None) -> None:
         lanes = 64 if backend == "tpu" else 16
         sets = [[b] for b in range(lanes)]
         opt_hard = GoalOptimizer(goal_names=HARD_GOALS)
-        t0 = time.monotonic()
-        opt_hard.batch_remove_scenarios(h_state, h_placement, h_meta, sets,
-                                        num_candidates=512)
-        batch_s = time.monotonic() - t0
+        batch_s, batch_res, batch_fresh = _timed_once(
+            lambda: opt_hard.batch_remove_scenarios(
+                h_state, h_placement, h_meta, sets, num_candidates=512))
         # vs_baseline stays budget/whole-batch (comparable across rounds);
         # per_lane_vs_budget is the honest per-study comparison — the
         # reference runs each decommission what-if as a separate request.
@@ -269,65 +347,82 @@ def run(backend: str, only=None) -> None:
               batch_s, backend, value_per_lane=round(batch_s / lanes, 4),
               per_lane_vs_budget=round(
                   NORTH_STAR_BUDGET_S / max(batch_s / lanes, 1e-9), 3),
-              lanes=lanes, includes_compile=True,
-              compile_cache="cold")
+              lanes=lanes, **_batch_quality(batch_res),
+              **_compile_fields(batch_fresh))
         # Warm repeat: the in-process jit cache now holds every lane program —
-        # this is what the precompute daemon's steady state (and any repeat
+        # this is what the warmup daemon's steady state (and any repeat
         # what-if at the same size class) pays.
         sets_w = [[lanes + b] for b in range(lanes)]
-        t0 = time.monotonic()
-        opt_hard.batch_remove_scenarios(h_state, h_placement, h_meta, sets_w,
-                                        num_candidates=512)
-        warm_s = time.monotonic() - t0
+        warm_s, warm_res, warm_fresh = _timed_once(
+            lambda: opt_hard.batch_remove_scenarios(
+                h_state, h_placement, h_meta, sets_w, num_candidates=512))
         _emit("remove_broker_what_ifs_2600brokers_1m_replicas_hard_goals_warm",
               warm_s, backend, value_per_lane=round(warm_s / lanes, 4),
               per_lane_vs_budget=round(
                   NORTH_STAR_BUDGET_S / max(warm_s / lanes, 1e-9), 3),
-              lanes=lanes, includes_compile=False,
-              compile_cache="warm")
+              lanes=lanes, **_batch_quality(warm_res),
+              **_compile_fields(warm_fresh))
+        del batch_res, warm_res
 
         # BASELINE config #5 AT SPEC — "decommission 64 at once" is the
         # reference's RemoveBrokersRunnable semantics: ONE operation removes
         # a *set* of brokers, all 64 brokers' replicas evacuating in the same
         # solve (a different, harder problem than 64 single-broker what-ifs).
-        t0 = time.monotonic()
-        opt_hard.batch_remove_scenarios(
-            h_state, h_placement, h_meta, [list(range(64))],
-            num_candidates=512)
-        one_s = time.monotonic() - t0
+        one_s, one_res, one_fresh = _timed_once(
+            lambda: opt_hard.batch_remove_scenarios(
+                h_state, h_placement, h_meta, [list(range(64))],
+                num_candidates=512))
         _emit("remove_64_brokers_single_scenario_2600brokers_1m_replicas_"
               "hard_goals", one_s, backend, brokers_removed=64, scenarios=1,
-              includes_compile=True, compile_cache="cold")
+              **_batch_quality(one_res), **_compile_fields(one_fresh))
         # Warm repeat on a different 64-broker set: what a second
         # decommission request at this size class pays.
-        t0 = time.monotonic()
-        opt_hard.batch_remove_scenarios(
-            h_state, h_placement, h_meta, [list(range(64, 128))],
-            num_candidates=512)
-        one_w = time.monotonic() - t0
+        one_w, one_w_res, one_w_fresh = _timed_once(
+            lambda: opt_hard.batch_remove_scenarios(
+                h_state, h_placement, h_meta, [list(range(64, 128))],
+                num_candidates=512))
         _emit("remove_64_brokers_single_scenario_2600brokers_1m_replicas_"
               "hard_goals_warm", one_w, backend, brokers_removed=64,
-              scenarios=1, includes_compile=False, compile_cache="warm")
+              scenarios=1, **_batch_quality(one_w_res),
+              **_compile_fields(one_w_fresh))
+        del one_res, one_w_res
 
-        # The full 64-lane what-if batch, run even on CPU (once, slow is
-        # fine) so a number at BASELINE's exact lane count exists.  Guarded:
-        # a batch-64 1M-replica program may exceed host RAM on the CPU
-        # fallback — skip honestly rather than die and lose prior lines.
+        # The full 64-lane what-if batch, run even on CPU (once cold, once
+        # warm; slow is fine) so numbers at BASELINE's exact lane count
+        # exist.  The lane-chunk planner should route 64 lanes through the
+        # 16-wide executables the round-comparable rows already compiled —
+        # fresh_compiles says whether it did.  Guarded: a 1M-replica batch
+        # may exceed host RAM on the CPU fallback — skip honestly rather
+        # than die and lose prior lines.
         if lanes != 64:
             try:
                 sets64 = [[b] for b in range(64)]
-                t0 = time.monotonic()
-                opt_hard.batch_remove_scenarios(
-                    h_state, h_placement, h_meta, sets64, num_candidates=512)
-                b64_s = time.monotonic() - t0
+                b64_s, b64_res, b64_fresh = _timed_once(
+                    lambda: opt_hard.batch_remove_scenarios(
+                        h_state, h_placement, h_meta, sets64,
+                        num_candidates=512))
                 _emit("remove_broker_what_ifs_64lanes_2600brokers_1m_replicas"
                       "_hard_goals", b64_s, backend,
                       value_per_lane=round(b64_s / 64, 4),
                       per_lane_vs_budget=round(
                           NORTH_STAR_BUDGET_S / max(b64_s / 64, 1e-9), 3),
-                      lanes=64, includes_compile=True, compile_cache="cold")
+                      lanes=64, **_batch_quality(b64_res),
+                      **_compile_fields(b64_fresh))
+                del b64_res
+                sets64_w = [[64 + b] for b in range(64)]
+                w64_s, w64_res, w64_fresh = _timed_once(
+                    lambda: opt_hard.batch_remove_scenarios(
+                        h_state, h_placement, h_meta, sets64_w,
+                        num_candidates=512))
+                _emit("remove_broker_what_ifs_64lanes_2600brokers_1m_replicas"
+                      "_hard_goals_warm", w64_s, backend,
+                      value_per_lane=round(w64_s / 64, 4),
+                      per_lane_vs_budget=round(
+                          NORTH_STAR_BUDGET_S / max(w64_s / 64, 1e-9), 3),
+                      lanes=64, **_batch_quality(w64_res),
+                      **_compile_fields(w64_fresh))
+                del w64_res
             except MemoryError:
-                import sys
                 sys.stderr.write("64-lane batch exceeded host RAM on the CPU "
                                  "fallback; row skipped\n")
         del h_state, h_placement, opt_hard
@@ -338,7 +433,7 @@ def run(backend: str, only=None) -> None:
     # Headline repeated LAST: the driver's artifact parser takes the tail line.
     if headline is not None:
         _emit("proposal_generation_wall_clock_200brokers_50k_replicas_"
-              "full_goals", headline, backend)
+              "full_goals", headline[0], backend, **headline[1])
 
 
 def _replay_captured_tpu_rows() -> None:

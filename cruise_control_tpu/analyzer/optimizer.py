@@ -200,6 +200,26 @@ class BatchScenarioResult:
         import jax
         return jax.tree_util.tree_map(lambda x: x[s], self.final_placements)
 
+    def balancedness(self, s: int) -> float:
+        """Per-lane balancedness on the same hard=3.0/soft=1.0 weights as
+        :func:`balancedness_score` (lane s's violated_after row stands in
+        for the sequential run's goal_infos)."""
+        from cruise_control_tpu.analyzer.goals.registry import goal_by_name
+        total = 0.0
+        got = 0.0
+        for g, name in enumerate(self.goal_names):
+            w = (_BALANCEDNESS_WEIGHT_HARD if goal_by_name(name).is_hard
+                 else _BALANCEDNESS_WEIGHT_SOFT)
+            total += w
+            if int(self.violated_after[s, g]) == 0:
+                got += w
+        return 100.0 * got / total if total else 100.0
+
+    def quality(self, s: int) -> Dict:
+        """The per-row quality fields every bench row carries."""
+        return {"violated_after": int(self.violated_after[s].sum()),
+                "balancedness": round(self.balancedness(s), 3)}
+
 
 class GoalOptimizer:
     """Runs a prioritized goal list over a frozen snapshot; caches the last
@@ -445,12 +465,79 @@ class GoalOptimizer:
                             num_candidates, scenario_sets,
                             alive_s, excl_move_s, excl_lead_s
                             ) -> BatchScenarioResult:
-        """Shared lane runner: one vmapped solve per goal over per-lane
-        liveness/exclusion masks."""
+        """Shared lane runner, routed through the compile service's lane-chunk
+        plan: an S-lane batch is split into blocks at already-compiled (or
+        canonical-bucket) lane widths, so a 64-lane request rides the 16-lane
+        executable 4× instead of compiling a fresh 64-wide program (BENCH_r05:
+        383 s cold at 64 lanes vs ~5 s/16-lane block warm).  Padding lanes
+        duplicate the last real lane's masks and are trimmed from the result.
+
+        Mesh-sharded runs are never chunked: lane count there is part of the
+        sharding layout, and splitting would fight ``scenario_shardings``.
+        """
+        from cruise_control_tpu.compilesvc.chunking import plan_is_identity
+        from cruise_control_tpu.compilesvc.service import compile_service
+
+        import jax
+
+        s_n = len(scenario_sets)
+        svc = compile_service()
+        lane_key = None
+        plan = None
+        if self.solver.mesh is None:
+            lane_key = svc.lane_key([g.name for g in goals],
+                                    state.num_replicas_padded,
+                                    int(np.asarray(alive_s).shape[1]),
+                                    num_candidates)
+            plan = svc.plan_lanes(s_n, lane_key)
+
+        if plan is None or plan_is_identity(plan, s_n):
+            out = self._run_lane_block(gctx, state, placement, goals,
+                                       num_candidates, alive_s, excl_move_s,
+                                       excl_lead_s)
+            if lane_key is not None:
+                svc.note_lanes_compiled(lane_key, s_n)
+            rounds, moves, violated, stranded, placement_s = out
+        else:
+            blocks = []
+            for chunk in plan:
+                # Padding lanes re-run the last real lane; harmless work that
+                # keeps every block at a canonical compiled width.
+                idx = np.minimum(chunk.start + np.arange(chunk.size), s_n - 1)
+                out = self._run_lane_block(
+                    gctx, state, placement, goals, num_candidates,
+                    alive_s[idx], excl_move_s[idx], excl_lead_s[idx])
+                svc.note_lanes_compiled(lane_key, chunk.size)
+                n = chunk.n_real
+                blocks.append(tuple(
+                    jax.tree_util.tree_map(lambda x: x[:n], part)
+                    for part in out))
+            rounds = np.concatenate([b[0] for b in blocks], axis=0)
+            moves = np.concatenate([b[1] for b in blocks], axis=0)
+            violated = np.concatenate([b[2] for b in blocks], axis=0)
+            stranded = np.concatenate([b[3] for b in blocks], axis=0)
+            placement_s = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+                *[b[4] for b in blocks])
+
+        return BatchScenarioResult(
+            scenario_sets=[list(map(int, ids)) for ids in scenario_sets],
+            goal_names=[g.name for g in goals],
+            violated_after=violated,
+            moves=moves,
+            rounds=rounds,
+            stranded_after=stranded,
+            final_placements=placement_s,
+        )
+
+    def _run_lane_block(self, gctx, state, placement, goals, num_candidates,
+                        alive_s, excl_move_s, excl_lead_s):
+        """One vmapped solve per goal over a block of lanes; returns host-local
+        (rounds[S,G], moves[S,G], violated[S,G], stranded[S], placements)."""
         import jax
         import jax.numpy as jnp
 
-        s_n = len(scenario_sets)
+        s_n = int(np.asarray(alive_s).shape[0])
         alive_j = jnp.asarray(alive_s)
         excl_move_j = jnp.asarray(excl_move_s)
         excl_lead_j = jnp.asarray(excl_lead_s)
@@ -492,13 +579,4 @@ class GoalOptimizer:
         moves = np.stack([np.asarray(m) for _, m, _ in device_stats], axis=1)
         violated = np.stack([np.asarray(v) for _, _, v in device_stats], axis=1)
         stranded = np.asarray(stranded_d)
-
-        return BatchScenarioResult(
-            scenario_sets=[list(map(int, ids)) for ids in scenario_sets],
-            goal_names=[g.name for g in goals],
-            violated_after=violated,
-            moves=moves,
-            rounds=rounds,
-            stranded_after=stranded,
-            final_placements=placement_s,
-        )
+        return rounds, moves, violated, stranded, placement_s

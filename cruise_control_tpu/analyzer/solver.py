@@ -31,6 +31,7 @@ round against fresh aggregates.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple
 
@@ -53,6 +54,7 @@ from cruise_control_tpu.analyzer.context import (
 )
 from cruise_control_tpu.analyzer.goals.base import Goal
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
+from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_telemetry
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
 
@@ -839,6 +841,41 @@ def _intra_disk_phase(goal: Goal, num_candidates: int):
     return phase
 
 
+class _CompileTracked:
+    """Callable proxy over a jitted function that feeds compile telemetry.
+
+    jit retraces per input *shape*, so one executable family (one
+    ``_round_cache`` entry) can hide several XLA compiles — e.g. the batch
+    solve recompiles for each new lane count.  A growth of the jit cache
+    around a call marks that call as a compile; its wall time (trace +
+    compile + that first execution) is the compile timer.  Attribute access
+    delegates to the wrapped jit function so ``lower()``/AOT callers keep
+    working.
+    """
+
+    def __init__(self, fn, label_fn):
+        self._fn = fn
+        self._label_fn = label_fn
+        self._ever_called = False
+
+    def __call__(self, *args, **kwargs):
+        size_fn = getattr(self._fn, "_cache_size", None)
+        before = size_fn() if size_fn is not None else None
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        elapsed = time.monotonic() - t0
+        fresh = (size_fn() > before if before is not None
+                 else not self._ever_called)
+        self._ever_called = True
+        if fresh:
+            _compile_telemetry().record_compile(
+                self._label_fn(*args, **kwargs), elapsed)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
 class GoalSolver:
     """Owns the per-goal jitted round functions; reused across optimizations
     with identical shapes (jit caches on (goal key, priors key, shapes))."""
@@ -997,16 +1034,29 @@ class GoalSolver:
 
         return round_body
 
+    def _cached_executable(self, key, bucket: str, build, label_fn=None):
+        """``_round_cache`` get-or-create with compilesvc telemetry: a hit is
+        a found executable family, a miss builds one, and the returned proxy
+        reports each actual XLA compile inside the family (per-shape
+        retraces) under its bucket label."""
+        cached = self._round_cache.get(key)
+        tel = _compile_telemetry()
+        if cached is not None:
+            tel.record_hit(bucket)
+            return cached
+        tel.record_miss(bucket)
+        fn = _CompileTracked(build(), label_fn or (lambda *a, **k: bucket))
+        self._round_cache[key] = fn
+        return fn
+
     def _round_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
         """One jitted solver round (kept for the driver's single-chip
         compile check and for round-granular tests)."""
         c = self._width(goal, num_replicas_padded)
         key = ("round", goal.key(), tuple(g.key() for g in priors), c)
-        if key in self._round_cache:
-            return self._round_cache[key]
-        round_fn = jax.jit(self._round_body(goal, priors, c))
-        self._round_cache[key] = round_fn
-        return round_fn
+        return self._cached_executable(
+            key, f"R{num_replicas_padded}-C{c}",
+            lambda: jax.jit(self._round_body(goal, priors, c)))
 
     def _solve_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
         """The whole per-goal convergence loop as ONE jitted dispatch.
@@ -1021,11 +1071,9 @@ class GoalSolver:
         """
         c = self._width(goal, num_replicas_padded)
         key = ("solve", goal.key(), tuple(g.key() for g in priors), c)
-        if key in self._round_cache:
-            return self._round_cache[key]
-        solve = jax.jit(self._solve_body(goal, priors, c))
-        self._round_cache[key] = solve
-        return solve
+        return self._cached_executable(
+            key, f"R{num_replicas_padded}-C{c}",
+            lambda: jax.jit(self._solve_body(goal, priors, c)))
 
     # Aggregates carried across rounds are re-synced from a full O(R)
     # recompute every this-many rounds, bounding incremental scatter-drift
@@ -1134,33 +1182,39 @@ class GoalSolver:
         """
         c = min(num_candidates, num_replicas_padded)
         key = ("batch", goal.key(), tuple(g.key() for g in priors), c)
-        if key in self._round_cache:
-            return self._round_cache[key]
-        solve_body = self._solve_body(goal, priors, c)
 
-        @jax.jit
-        def batch(gctx: GoalContext, alive_s, excl_move_s, excl_lead_s,
-                  placement_s):
-            def one(alive, excl_move, excl_lead, placement):
-                state = gctx.state.replace(alive=alive)
-                ok = alive & state.broker_valid
-                host_cap = jax.ops.segment_sum(
-                    jnp.where(ok[:, None], state.capacity, 0.0),
-                    state.host, num_segments=gctx.num_hosts)
-                g2 = gctx.replace(
-                    state=state, host_capacity=host_cap,
-                    excluded_for_replica_move=excl_move,
-                    excluded_for_leadership=excl_lead)
-                out = solve_body(g2, placement,
-                                 compute_aggregates(g2, placement))
-                # Drop the final aggregates from the vmapped outputs: a
-                # [scenarios, topics, brokers] leader-count stack is hundreds
-                # of MB at north-star scale and no lane consumer wants it.
-                return (out[0],) + out[2:]
-            return jax.vmap(one)(alive_s, excl_move_s, excl_lead_s, placement_s)
+        def build():
+            solve_body = self._solve_body(goal, priors, c)
 
-        self._round_cache[key] = batch
-        return batch
+            @jax.jit
+            def batch(gctx: GoalContext, alive_s, excl_move_s, excl_lead_s,
+                      placement_s):
+                def one(alive, excl_move, excl_lead, placement):
+                    state = gctx.state.replace(alive=alive)
+                    ok = alive & state.broker_valid
+                    host_cap = jax.ops.segment_sum(
+                        jnp.where(ok[:, None], state.capacity, 0.0),
+                        state.host, num_segments=gctx.num_hosts)
+                    g2 = gctx.replace(
+                        state=state, host_capacity=host_cap,
+                        excluded_for_replica_move=excl_move,
+                        excluded_for_leadership=excl_lead)
+                    out = solve_body(g2, placement,
+                                     compute_aggregates(g2, placement))
+                    # Drop the final aggregates from the vmapped outputs: a
+                    # [scenarios, topics, brokers] leader-count stack is hundreds
+                    # of MB at north-star scale and no lane consumer wants it.
+                    return (out[0],) + out[2:]
+                return jax.vmap(one)(alive_s, excl_move_s, excl_lead_s,
+                                     placement_s)
+            return batch
+
+        # Lane count is a shape, not part of the cache key — the proxy labels
+        # each per-width compile with its own -L bucket.
+        return self._cached_executable(
+            key, f"R{num_replicas_padded}-C{c}", build,
+            label_fn=lambda gctx, alive_s, *a, **k:
+                f"R{num_replicas_padded}-C{c}-L{alive_s.shape[0]}")
 
     def optimize_goal(self, goal: Goal, priors: Sequence[Goal], gctx: GoalContext,
                       placement: Placement, agg: Optional[Aggregates] = None,

@@ -1,0 +1,38 @@
+"""AOT compile-plan subsystem.
+
+Every XLA executable the solver ever needs is owned here: shape-bucket
+policy (``buckets``), lane-chunk planning (``chunking``), the persistent
+compile-cache manager (``cache``), compile telemetry (``telemetry``), the
+service facade tying them together (``service``) and the startup warmup
+daemon (``warmup``).  BENCH_r05 showed compilation — not the solve —
+dominating cold wall clock (383 s vs ~6 s/lane warm at 64 lanes); the
+discipline encoded here is the standard JAX-serving one: compile once per
+canonical shape bucket, route everything else through what is already
+compiled, and persist what must be compiled.
+"""
+
+from cruise_control_tpu.compilesvc.buckets import ShapeBucketPolicy
+from cruise_control_tpu.compilesvc.cache import PersistentCompileCache
+from cruise_control_tpu.compilesvc.chunking import LaneChunk, plan_lane_chunks
+from cruise_control_tpu.compilesvc.service import (
+    CompileService,
+    compile_service,
+    configure,
+    set_compile_service,
+)
+from cruise_control_tpu.compilesvc.telemetry import CompileTelemetry, telemetry
+from cruise_control_tpu.compilesvc.warmup import WarmupDaemon
+
+__all__ = [
+    "CompileService",
+    "CompileTelemetry",
+    "LaneChunk",
+    "PersistentCompileCache",
+    "ShapeBucketPolicy",
+    "WarmupDaemon",
+    "compile_service",
+    "configure",
+    "plan_lane_chunks",
+    "set_compile_service",
+    "telemetry",
+]

@@ -1,0 +1,194 @@
+"""Persistent compile-cache manager.
+
+Generalizes the bench's ``CC_TPU_PERSIST_CACHE`` opt-in
+(``utils/hermetic.enable_persistent_compilation_cache``) into a managed
+cache usable on CPU and TPU:
+
+- **Versioned keys.**  XLA's persistent cache is content-addressed, but a
+  content hash does not protect against loading executables built by a
+  different jaxlib or for a differently-featured host (XLA:CPU AOT results
+  from a machine-feature-skewed process can SIGILL — see tests/conftest.py).
+  Entries therefore live under
+  ``<root>/v<schema>/<platform>-<machine_fp>/jaxlib-<ver>/<stack>/<bucket>``:
+  a jaxlib upgrade, a host change, a goal-stack change or a shape-bucket
+  change each land in a fresh directory instead of poisoning an old one.
+- **Eviction.**  Oldest-first by mtime down to ``max_bytes`` per activated
+  directory, so a long-lived service cannot grow the cache without bound.
+- **Corruption-safe fallback.**  A directory whose manifest is unreadable
+  or mismatched is quarantined (renamed aside) and recreated; any
+  unexpected failure deactivates the cache for this process instead of
+  raising — a broken cache must never take down a solve.
+
+Default-off on CPU: the cross-process machine-feature skew above makes a
+shared CPU cache genuinely unsafe on this box, so CPU use is an explicit
+config opt-in (``compile.persistent.cache.enabled``); the TPU child keeps
+its env opt-in, now routed through this manager.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+LOG = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+_MANIFEST = "cc-cache-manifest.json"
+
+
+def machine_fingerprint() -> str:
+    """Short stable fingerprint of the host the executables target."""
+    import platform
+    import sys
+    raw = "|".join((platform.machine(), platform.processor() or "",
+                    platform.system(),
+                    f"py{sys.version_info[0]}.{sys.version_info[1]}"))
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+
+def jaxlib_version() -> str:
+    try:
+        import jaxlib
+        return str(jaxlib.__version__)
+    except Exception:   # noqa: BLE001 — version probing must not raise
+        import jax
+        return str(jax.__version__)
+
+
+def default_root() -> str:
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(root, "cruise_control_tpu", "compile_cache")
+
+
+class PersistentCompileCache:
+    def __init__(self, root: Optional[str] = None,
+                 max_bytes: int = 4 << 30,
+                 enabled: bool = False):
+        self.root = root or default_root()
+        self.max_bytes = int(max_bytes)
+        self.enabled = bool(enabled)
+        self.active_dir: Optional[str] = None
+        self.last_warm: bool = False
+
+    # ------------------------------------------------------------ keying
+
+    def cache_dir(self, platform_name: str,
+                  goal_stack_hash: str = "anystack",
+                  bucket: str = "anyshape") -> str:
+        return os.path.join(
+            self.root, f"v{SCHEMA_VERSION}",
+            f"{platform_name}-{machine_fingerprint()}",
+            f"jaxlib-{jaxlib_version()}", goal_stack_hash, bucket)
+
+    def _manifest(self) -> Dict:
+        return {"schema": SCHEMA_VERSION, "jaxlib": jaxlib_version(),
+                "fingerprint": machine_fingerprint()}
+
+    # ---------------------------------------------------------- lifecycle
+
+    def activate(self, platform_name: Optional[str] = None,
+                 goal_stack_hash: str = "anystack",
+                 bucket: str = "anyshape") -> bool:
+        """Point JAX's persistent compilation cache at the versioned entry
+        directory.  Returns True when the entry already holds executables
+        ("warm").  Never raises: any failure logs and leaves the cache off.
+        """
+        if not self.enabled:
+            return False
+        try:
+            if platform_name is None:
+                import jax
+                platform_name = jax.default_backend()
+            path = self.cache_dir(platform_name, goal_stack_hash, bucket)
+            os.makedirs(path, exist_ok=True)
+            self._validate_or_quarantine(path)
+            os.makedirs(path, exist_ok=True)
+            self.evict(path)
+            warm = any(e.name != _MANIFEST for e in os.scandir(path))
+            with open(os.path.join(path, _MANIFEST), "w") as f:
+                json.dump(self._manifest(), f)
+            import jax
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+            self.active_dir = path
+            self.last_warm = warm
+            return warm
+        except Exception as e:   # noqa: BLE001 — cache must never kill a solve
+            LOG.warning("persistent compile cache unavailable (%s); "
+                        "continuing without it", e)
+            self.active_dir = None
+            self.last_warm = False
+            return False
+
+    def _validate_or_quarantine(self, path: str) -> None:
+        """A manifest that cannot be read or does not match this process's
+        versioned key means the directory was corrupted or written by an
+        incompatible producer — move it aside rather than load from it."""
+        manifest_path = os.path.join(path, _MANIFEST)
+        populated = any(e.name != _MANIFEST for e in os.scandir(path))
+        if not populated and not os.path.exists(manifest_path):
+            return   # fresh directory
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            if (manifest.get("schema") == SCHEMA_VERSION
+                    and manifest.get("jaxlib") == jaxlib_version()
+                    and manifest.get("fingerprint") == machine_fingerprint()):
+                return
+            reason = "manifest mismatch"
+        except (OSError, ValueError):
+            reason = "unreadable manifest"
+        quarantine = path + ".quarantined"
+        n = 0
+        while os.path.exists(quarantine):
+            n += 1
+            quarantine = f"{path}.quarantined.{n}"
+        os.rename(path, quarantine)
+        LOG.warning("compile cache %s quarantined to %s (%s)", path,
+                    quarantine, reason)
+
+    def evict(self, path: Optional[str] = None) -> int:
+        """Drop oldest entries until the directory fits ``max_bytes``;
+        returns bytes removed."""
+        path = path or self.active_dir
+        if path is None or not os.path.isdir(path):
+            return 0
+        entries = []
+        total = 0
+        for e in os.scandir(path):
+            if not e.is_file() or e.name == _MANIFEST:
+                continue
+            st = e.stat()
+            entries.append((st.st_mtime, st.st_size, e.path))
+            total += st.st_size
+        removed = 0
+        for _mtime, size, fp in sorted(entries):
+            if total - removed <= self.max_bytes:
+                break
+            try:
+                os.unlink(fp)
+                removed += size
+            except OSError:
+                pass
+        return removed
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> Dict:
+        out: Dict = {"enabled": self.enabled, "root": self.root,
+                     "max_bytes": self.max_bytes,
+                     "active_dir": self.active_dir,
+                     "warm": self.last_warm,
+                     "entries": 0, "bytes": 0}
+        if self.active_dir and os.path.isdir(self.active_dir):
+            for e in os.scandir(self.active_dir):
+                if e.is_file() and e.name != _MANIFEST:
+                    out["entries"] += 1
+                    out["bytes"] += e.stat().st_size
+        return out

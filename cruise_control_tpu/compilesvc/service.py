@@ -1,0 +1,168 @@
+"""CompileService: the facade that owns every XLA executable.
+
+One object ties the subsystem together: the shape-bucket policy decides the
+canonical padded shapes (R, B, C, L), the lane-chunk planner routes what-if
+batches through already-compiled lane widths, the persistent cache manager
+survives process restarts, and telemetry counts every hit/miss/compile.
+
+Callers never talk to jit directly about shapes:
+
+- ``facade.CruiseControl`` asks ``pad_targets`` when freezing snapshots;
+- ``analyzer.optimizer`` asks ``plan_lanes``/``note_lanes_compiled`` around
+  the batched scenario runner;
+- ``main.build_app`` calls ``configure(config)`` once at startup and the
+  warmup daemon AOT-warms the configured goal stack's bucket set;
+- ``servlet`` renders ``snapshot()`` as the ``compile_cache`` admin view.
+
+A process-wide instance (``compile_service()``) exists so code deep in the
+solver does not need plumbing; ``set_compile_service`` swaps it in tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.compilesvc.buckets import ShapeBucketPolicy
+from cruise_control_tpu.compilesvc.cache import PersistentCompileCache
+from cruise_control_tpu.compilesvc.chunking import LaneChunk, plan_lane_chunks
+from cruise_control_tpu.compilesvc.telemetry import CompileTelemetry, telemetry
+
+
+def goal_stack_hash(goal_names: Iterable[str]) -> str:
+    """Order-sensitive short hash of a goal stack — part of the persistent
+    cache key and of the compiled-lane-width registry key."""
+    raw = "\x1f".join(str(n) for n in goal_names)
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+
+class CompileService:
+    def __init__(self,
+                 policy: Optional[ShapeBucketPolicy] = None,
+                 cache: Optional[PersistentCompileCache] = None,
+                 compile_telemetry: Optional[CompileTelemetry] = None,
+                 chunking_enabled: bool = True,
+                 warmup_enabled: bool = False,
+                 warmup_lanes: int = 4):
+        self.policy = policy or ShapeBucketPolicy()
+        self.cache = cache or PersistentCompileCache()
+        self.telemetry = compile_telemetry or telemetry()
+        self.chunking_enabled = bool(chunking_enabled)
+        self.warmup_enabled = bool(warmup_enabled)
+        self.warmup_lanes = int(warmup_lanes)
+        self._lock = threading.Lock()
+        # (stack_hash, R_padded, B_padded, C) -> lane widths already compiled
+        self._compiled_lanes: Dict[Tuple, Set[int]] = {}
+
+    # ------------------------------------------------------------- shapes
+
+    def pad_targets(self, n_replicas: int, n_brokers: int) -> Tuple[int, int]:
+        return self.policy.pad_targets(n_replicas, n_brokers)
+
+    def bucket_label(self, num_replicas_padded: int, num_candidates: int,
+                     lanes: Optional[int] = None) -> str:
+        return self.policy.bucket_label(num_replicas_padded, num_candidates,
+                                        lanes)
+
+    # ------------------------------------------------------ lane chunking
+
+    def lane_key(self, goal_names: Iterable[str], num_replicas_padded: int,
+                 num_brokers_padded: int, num_candidates: int) -> Tuple:
+        return (goal_stack_hash(goal_names), int(num_replicas_padded),
+                int(num_brokers_padded), int(num_candidates))
+
+    def compiled_lane_widths(self, key: Tuple) -> Set[int]:
+        with self._lock:
+            return set(self._compiled_lanes.get(key, ()))
+
+    def note_lanes_compiled(self, key: Tuple, width: int) -> None:
+        with self._lock:
+            self._compiled_lanes.setdefault(key, set()).add(int(width))
+
+    def plan_lanes(self, n_lanes: int, key: Optional[Tuple] = None
+                   ) -> List[LaneChunk]:
+        """Chunk plan for an ``n_lanes``-wide what-if batch.  With chunking
+        disabled the plan is the identity (one chunk at the native width)."""
+        if not self.chunking_enabled:
+            return [LaneChunk(size=int(n_lanes), start=0,
+                              n_real=int(n_lanes))]
+        compiled = self.compiled_lane_widths(key) if key is not None else set()
+        return plan_lane_chunks(
+            n_lanes, self.policy.lane_ladder, compiled=compiled,
+            max_chunk=self.policy.max_lane_bucket)
+
+    # ------------------------------------------------------------- admin
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            lane_registry = {
+                f"{k[0]}/R{k[1]}-B{k[2]}-C{k[3]}": sorted(v)
+                for k, v in sorted(self._compiled_lanes.items())}
+        return {
+            "policy": {
+                "replica_floor": self.policy.replica_floor,
+                "broker_floor": self.policy.broker_floor,
+                "growth": self.policy.growth,
+                "lane_ladder": list(self.policy.lane_ladder),
+                "max_lane_bucket": self.policy.max_lane_bucket,
+            },
+            "chunking_enabled": self.chunking_enabled,
+            "warmup_enabled": self.warmup_enabled,
+            "compiled_lane_widths": lane_registry,
+            "persistent_cache": self.cache.stats(),
+            "telemetry": self.telemetry.snapshot(),
+        }
+
+
+_GLOBAL: Optional[CompileService] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def compile_service() -> CompileService:
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = CompileService()
+    return _GLOBAL
+
+
+def set_compile_service(svc: Optional[CompileService]) -> None:
+    """Swap the process-wide service (tests; ``None`` resets to default)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = svc
+
+
+def configure(config) -> CompileService:
+    """Build the process-wide service from ``compile.*`` config keys and
+    install it.  ``config`` is a ``CruiseControlConfig`` (anything with
+    ``.get``)."""
+    def _get(key, default):
+        try:
+            v = config.get(key)
+        except Exception:   # noqa: BLE001 — missing key -> default
+            return default
+        return default if v is None else v
+
+    policy = ShapeBucketPolicy(
+        replica_floor=int(_get("compile.replica.pad.floor", 64)),
+        broker_floor=int(_get("compile.broker.pad.floor", 8)),
+        growth=float(_get("compile.bucket.growth", 2.0)),
+        max_lane_bucket=int(_get("compile.max.lane.bucket", 16)),
+    )
+    cache = PersistentCompileCache(
+        root=str(_get("compile.persistent.cache.path", "")) or None,
+        max_bytes=int(_get("compile.persistent.cache.max.bytes", 4 << 30)),
+        enabled=bool(_get("compile.persistent.cache.enabled", False)),
+    )
+    svc = CompileService(
+        policy=policy,
+        cache=cache,
+        chunking_enabled=bool(_get("compile.lane.chunking.enabled", True)),
+        warmup_enabled=bool(_get("compile.warmup.enabled", True)),
+        warmup_lanes=int(_get("compile.warmup.lanes", 4)),
+    )
+    set_compile_service(svc)
+    return svc

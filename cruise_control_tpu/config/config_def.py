@@ -11,10 +11,36 @@ from __future__ import annotations
 
 import enum
 import importlib
+import os
+import re
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from cruise_control_tpu.common.exceptions import ConfigError
+
+# ``${env:VAR}`` value indirection: secrets (TLS keystore passwords, webhook
+# tokens) stay out of properties files and are pulled from the process
+# environment when the config is loaded.
+_ENV_REF = re.compile(r"\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+def resolve_env_refs(raw: Any) -> Any:
+    """Substitute every ``${env:VAR}`` occurrence in a string value with the
+    environment variable's current value.  Non-strings and strings without a
+    reference pass through untouched; referencing an unset variable is a
+    ConfigError (a silently-empty secret is worse than a startup failure)."""
+    if not isinstance(raw, str) or "${env:" not in raw:
+        return raw
+
+    def _sub(m: "re.Match[str]") -> str:
+        var = m.group(1)
+        if var not in os.environ:
+            raise ConfigError(
+                f"config value references ${{env:{var}}} but {var} is not "
+                "set in the environment")
+        return os.environ[var]
+
+    return _ENV_REF.sub(_sub, raw)
 
 
 class ConfigType(enum.Enum):
@@ -105,6 +131,9 @@ class ConfigDef:
         try:
             if raw is None:
                 return None
+            # Programmatic overrides get the same ${env:VAR} indirection as
+            # properties files (load_properties already resolved those).
+            raw = resolve_env_refs(raw)
             if t is ConfigType.STRING or t is ConfigType.CLASS:
                 return str(raw)
             if t in (ConfigType.INT, ConfigType.LONG):
@@ -134,7 +163,7 @@ def load_properties(path: str) -> Dict[str, str]:
                 continue
             if "=" in line:
                 k, _, v = line.partition("=")
-                props[k.strip()] = v.strip()
+                props[k.strip()] = resolve_env_refs(v.strip())
     return props
 
 

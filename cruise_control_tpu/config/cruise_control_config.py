@@ -215,6 +215,47 @@ def _anomaly_def() -> ConfigDef:
     return d
 
 
+def _compile_def() -> ConfigDef:
+    """compilesvc keys (no reference analog — the reference JVM has no XLA
+    executables to manage)."""
+    d = ConfigDef()
+    d.define("compile.warmup.enabled", ConfigType.BOOLEAN, True,
+             doc="start the background warmup daemon on facade start_up; it "
+                 "runs real dryrun solves at the canonical shape buckets so "
+                 "the first operator request never pays cold-compile latency")
+    d.define("compile.warmup.lanes", ConfigType.INT, 4, range_validator(1),
+             doc="what-if lane width the warmup daemon pre-compiles")
+    d.define("compile.lane.chunking.enabled", ConfigType.BOOLEAN, True,
+             doc="route wide what-if batches through already-compiled lane "
+                 "executables (e.g. 64 lanes as 4x16) instead of compiling "
+                 "a fresh full-width program")
+    d.define("compile.max.lane.bucket", ConfigType.INT, 16, range_validator(1),
+             doc="largest lane executable compiled fresh; wider batches are "
+                 "chunked through this width (must be on the lane ladder)")
+    d.define("compile.replica.pad.floor", ConfigType.INT, 64,
+             range_validator(1),
+             doc="smallest replica-axis shape bucket (geometric growth above)")
+    d.define("compile.broker.pad.floor", ConfigType.INT, 8, range_validator(1),
+             doc="smallest broker-axis shape bucket")
+    d.define("compile.bucket.growth", ConfigType.DOUBLE, 2.0,
+             range_validator(1.001),
+             doc="geometric growth factor between consecutive shape buckets")
+    d.define("compile.persistent.cache.enabled", ConfigType.BOOLEAN, False,
+             doc="persist XLA executables across restarts under versioned "
+                 "keys (jaxlib version, machine fingerprint, goal stack, "
+                 "bucket).  Default off: XLA:CPU executables from a machine-"
+                 "feature-skewed producer can SIGILL the consumer, so CPU "
+                 "deployments must opt in knowingly")
+    d.define("compile.persistent.cache.path", ConfigType.STRING, "",
+             doc="cache root; empty = ~/.cache/cruise_control_tpu/"
+                 "compile_cache")
+    d.define("compile.persistent.cache.max.bytes", ConfigType.LONG,
+             4 * 1024 * 1024 * 1024, range_validator(1),
+             doc="per-entry-directory size cap; oldest executables evicted "
+                 "first")
+    return d
+
+
 def _webserver_def() -> ConfigDef:
     d = ConfigDef()
     d.define("webserver.http.port", ConfigType.INT, 9090)
@@ -275,7 +316,7 @@ class CruiseControlConfig:
     def __init__(self, props: Optional[Dict[str, Any]] = None):
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
-                           .merge(_webserver_def()))
+                           .merge(_compile_def()).merge(_webserver_def()))
         props = dict(props or {})
         known = self.definition.keys()
         self.originals = props

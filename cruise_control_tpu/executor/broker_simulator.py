@@ -319,6 +319,7 @@ def _serve_tcp(sim: "BrokerSimulator", port: int,
     and a disconnect — an unauthenticated peer cannot move replicas or read
     cluster state.  ``ssl_cert``/``ssl_key`` wrap the listener in TLS,
     protecting the token and the admin stream in transit."""
+    import errno
     import hmac
     import socket
     import threading
@@ -393,9 +394,18 @@ def _serve_tcp(sim: "BrokerSimulator", port: int,
                 conn, _ = srv.accept()
             except socket.timeout:
                 continue
-            except OSError:
+            except OSError as e:
                 # TLS handshake failure from a bad client must not kill the
-                # listener.
+                # listener — but a listener whose own socket is gone (closed
+                # fd, ENOTSOCK, EINVAL from shutdown) will fail every accept
+                # forever; continuing would busy-spin at 2 Hz for the life of
+                # the process.  Per-connection errors keep looping; fatal
+                # listener errors end the serve loop.
+                if e.errno in (errno.EBADF, errno.ENOTSOCK, errno.EINVAL):
+                    print(json.dumps({"error": f"listener socket unusable: "
+                                               f"{e}"}), file=sys.stderr,
+                          flush=True)
+                    return 1
                 continue
             threading.Thread(target=serve_client, args=(conn,),
                              daemon=True).start()

@@ -58,7 +58,10 @@ from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
 
 LOG = logging.getLogger(__name__)
 
-PAD_R, PAD_B = 64, 8   # snapshot padding size-class floors
+# Legacy snapshot padding size-class floors; the compile service's shape-
+# bucket policy (compilesvc.buckets.ShapeBucketPolicy) keeps them as its
+# smallest buckets, so pre-bucketing shapes stay canonical.
+PAD_R, PAD_B = 64, 8
 
 
 @dataclass
@@ -127,6 +130,11 @@ class CruiseControl:
         # lifecycle rides start_up/shutdown like the reference's reader rides
         # the AnomalyDetectorManager's.
         self.maintenance_reader = None
+        # Background compile warmup (compilesvc): AOT-compiles the configured
+        # goal stack's bucket set right after start_up so the first operator
+        # request never pays cold-compile latency.  Built lazily in start_up
+        # only when the compile service has warmup enabled.
+        self.warmup_daemon = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -149,8 +157,14 @@ class CruiseControl:
                 target=self._precompute_loop, name="proposal-precompute",
                 daemon=False)
             self._precompute_thread.start()
+        from cruise_control_tpu.compilesvc import compile_service
+        if compile_service().warmup_enabled:
+            self.warmup_daemon = self._build_warmup_daemon()
+            self.warmup_daemon.start()
 
     def shutdown(self) -> None:
+        if self.warmup_daemon is not None:
+            self.warmup_daemon.stop()
         if self.maintenance_reader is not None:
             self.maintenance_reader.stop()
         self._precompute_stop.set()
@@ -201,6 +215,69 @@ class CruiseControl:
             except Exception as e:          # noqa: BLE001 — keep the daemon up
                 LOG.warning("proposal precompute failed: %s", e)
 
+    # ------------------------------------------------------- compile warmup
+
+    def _freeze_bucketed(self, builder):
+        """Freeze a model builder at the compile service's canonical shape
+        buckets (geometric over the PAD_R/PAD_B floors), so every snapshot
+        of a similar-sized cluster lands on an already-compiled shape."""
+        from cruise_control_tpu.compilesvc import compile_service
+        n_replicas = sum(len(rs) for rs in builder.partitions().values())
+        pad_r, pad_b = compile_service().pad_targets(
+            n_replicas, len(builder.brokers()))
+        return builder.freeze(pad_replicas_to=pad_r, pad_brokers_to=pad_b)
+
+    def _build_warmup_daemon(self):
+        """Warm tasks run REAL solves at the bucket shapes: AOT
+        ``lower().compile()`` would skip jit's in-process dispatch cache, so
+        the first operator request would retrace anyway.  Task keys make
+        re-warming idempotent; failures (e.g. load monitor not yet complete)
+        are logged by the daemon and never fatal."""
+        from cruise_control_tpu.compilesvc import WarmupDaemon, compile_service
+
+        svc = compile_service()
+        daemon = WarmupDaemon()
+
+        def wait_model_ready(timeout_s: float = 600.0) -> None:
+            # start_up launches the warmer before the monitor has completed
+            # its first aggregation window; a warm task solving immediately
+            # would fail on "0 completed windows".  Poll completeness (and
+            # the daemon's abort probe, so shutdown is never blocked) until
+            # a model can actually be built.
+            req = (self.default_completeness
+                   or ModelCompletenessRequirements())
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if daemon.should_abort():
+                    raise RuntimeError("warmup aborted before model ready")
+                try:
+                    if self.load_monitor.meet_completeness_requirements(req):
+                        return
+                except Exception:   # noqa: BLE001 — monitor still booting
+                    pass
+                time.sleep(0.25)
+            raise TimeoutError(
+                f"load monitor produced no complete window in {timeout_s:.0f}s")
+
+        def warm_proposals():
+            wait_model_ready()
+            self.proposals()
+
+        def warm_whatif():
+            wait_model_ready()
+            builder = self.load_monitor.cluster_model_builder()
+            state, placement, meta = self._freeze_bucketed(builder)
+            width = max(1, svc.warmup_lanes)
+            first = [int(meta.broker_ids[0])]
+            self.optimizer.batch_remove_scenarios(
+                state, placement, meta, [list(first) for _ in range(width)])
+
+        daemon.add_task(("proposals", tuple(self.default_goals)),
+                        warm_proposals)
+        daemon.add_task(("whatif", tuple(self.default_goals),
+                         max(1, svc.warmup_lanes)), warm_whatif)
+        return daemon
+
     def _offline_logdirs(self):
         """Disk-failure source: the executor's cluster backend answers the
         describeLogDirs-shaped query (DiskFailureDetector.java:1-118);
@@ -235,9 +312,10 @@ class CruiseControl:
     # ---------------------------------------------------------- model views
 
     def cluster_model_snapshot(self, allow_capacity_estimation: bool = True):
+        from cruise_control_tpu.compilesvc import compile_service
         return self.load_monitor.cluster_model(
             allow_capacity_estimation=allow_capacity_estimation,
-            pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+            pad_fn=compile_service().pad_targets)
 
     def broker_stats(self) -> Dict:
         """GET /load (KafkaCruiseControl.clusterModel + brokerStats)."""
@@ -314,8 +392,7 @@ class CruiseControl:
                         pass
             if model_mutator is not None:
                 model_mutator(builder)
-            state, placement, meta = builder.freeze(pad_replicas_to=PAD_R,
-                                                    pad_brokers_to=PAD_B)
+            state, placement, meta = self._freeze_bucketed(builder)
             optimizer = (self.optimizer if goals == self.default_goals
                          else GoalOptimizer(constraint=self.constraint,
                                             goal_names=goals))
@@ -392,8 +469,7 @@ class CruiseControl:
         ``RemoveBrokersRunnable`` once per set; this shares the model build
         and the per-goal compilation across all scenarios."""
         builder = self.load_monitor.cluster_model_builder()
-        state, placement, meta = builder.freeze(pad_replicas_to=PAD_R,
-                                                pad_brokers_to=PAD_B)
+        state, placement, meta = self._freeze_bucketed(builder)
         goal_names = list(goals or self.default_goals)
         optimizer = (self.optimizer if goal_names == self.default_goals
                      else GoalOptimizer(constraint=self.constraint,

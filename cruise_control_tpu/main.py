@@ -55,6 +55,15 @@ def build_app(config: CruiseControlConfig,
     """Wire the full stack against the in-process demo cluster (the role of
     the reference's embedded-broker harness); real deployments substitute
     the metadata/admin/sampler seams."""
+    # Install the process-wide compile service from compile.* keys before
+    # anything can touch a jitted function, and point JAX's persistent
+    # compilation cache at the versioned entry for this goal stack (no-op
+    # unless compile.persistent.cache.enabled).
+    from cruise_control_tpu.compilesvc import configure as configure_compile
+    from cruise_control_tpu.compilesvc.service import goal_stack_hash
+    compile_svc = configure_compile(config)
+    compile_svc.cache.activate(
+        goal_stack_hash=goal_stack_hash(config.goal_names("default.goals")))
     backend = demo_metadata()
     metadata_client = MetadataClient(backend,
                                      ttl_ms=config["metadata.max.age.ms"])

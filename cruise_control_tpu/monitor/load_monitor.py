@@ -188,8 +188,15 @@ class LoadMonitor:
         allow_capacity_estimation: bool = True,
         pad_replicas_to: int = 1,
         pad_brokers_to: int = 1,
+        pad_fn=None,
     ) -> Tuple[ClusterState, Placement, ClusterMeta]:
-        """Build a frozen snapshot (LoadMonitor.clusterModel :530-582)."""
+        """Build a frozen snapshot (LoadMonitor.clusterModel :530-582).
+
+        ``pad_fn(n_replicas, n_brokers) -> (pad_replicas_to, pad_brokers_to)``
+        lets the caller pick pad targets from the RAW model counts — the
+        compile service's shape-bucket policy needs the counts before the
+        freeze, and only this method sees the populated model under the
+        generation lock."""
         requirements = requirements or ModelCompletenessRequirements()
         to_ms = time.time() * 1000 if to_ms is None else to_ms
         with self.acquire_for_model_generation(), self._model_timer.time():
@@ -200,6 +207,10 @@ class LoadMonitor:
                 group_granularity=requirements.include_all_topics)
             result = self.partition_aggregator.aggregate(from_ms, to_ms, options)
             cm = self._populate(metadata, result, allow_capacity_estimation)
+            if pad_fn is not None:
+                pad_replicas_to, pad_brokers_to = pad_fn(
+                    sum(len(rs) for rs in cm.partitions().values()),
+                    len(cm.brokers()))
             return cm.freeze(pad_replicas_to=pad_replicas_to,
                              pad_brokers_to=pad_brokers_to)
 

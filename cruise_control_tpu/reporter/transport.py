@@ -199,8 +199,19 @@ class TransportServer:
                 import hmac
                 import json
                 authed = outer.auth_secret is None
+                if not authed:
+                    # An unauthenticated peer gets HANDSHAKE_TIMEOUT_S to
+                    # present its auth frame; without the deadline, a client
+                    # that connects and goes silent pins this handler thread
+                    # forever (same DoS shape the TLS setup already guards).
+                    self.connection.settimeout(outer.HANDSHAKE_TIMEOUT_S)
                 while True:
-                    line = self.rfile.readline(MAX_FRAME_BYTES)
+                    try:
+                        line = self.rfile.readline(MAX_FRAME_BYTES)
+                    except OSError:
+                        # Pre-auth deadline expired (or the socket died):
+                        # drop the peer.
+                        return
                     if not line:
                         return
                     if len(line) >= MAX_FRAME_BYTES and \
@@ -233,6 +244,9 @@ class TransportServer:
                                          "error": "authentication required"})
                             return
                         authed = True
+                        # Authenticated peers are long-lived publishers;
+                        # clear the handshake deadline.
+                        self.connection.settimeout(None)
                         self._reply({"ok": True})
                         continue
                     try:
@@ -346,7 +360,16 @@ class SocketTransport:
             sock.sendall((json.dumps(
                 {"op": "auth", "token": self._auth_secret}) + "\n").encode())
             line = self._rfile.readline()
-            if not line or not json.loads(line).get("ok"):
+            try:
+                accepted = bool(line) and json.loads(line).get("ok")
+            except ValueError as e:
+                # A garbled auth reply is a CONNECTION problem (proxy junk,
+                # mid-frame disconnect) — surface it as such so _request's
+                # reconnect-and-retry path handles it, instead of a raw
+                # JSONDecodeError escaping to the caller.
+                raise ConnectionError(
+                    f"malformed transport auth reply: {e}") from None
+            if not accepted:
                 raise ConnectionError("transport authentication rejected")
 
     def _request(self, req: dict, idempotent: bool = True) -> dict:
@@ -363,7 +386,13 @@ class SocketTransport:
                     line = self._rfile.readline()
                     if not line:
                         raise ConnectionError("transport peer closed")
-                    resp = json.loads(line)
+                    try:
+                        resp = json.loads(line)
+                    except ValueError as e:
+                        # Same contract as the auth reply: a response that is
+                        # not JSON means the stream is broken, not the request.
+                        raise ConnectionError(
+                            f"malformed transport reply: {e}") from None
                     if not resp.get("ok"):
                         raise RuntimeError(
                             f"transport error: {resp.get('error')}")

@@ -54,6 +54,9 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
                 "?json=true)", [
         ("json", "boolean", "JSON snapshot instead of Prometheus text"),
     ], "VIEWER"),
+    "compile_cache": ("Compile-service state: shape-bucket policy, compiled "
+                      "lane widths, persistent XLA cache, warmup progress, "
+                      "per-bucket compile/hit/miss counters", [], "VIEWER"),
     "rebalance": ("Full-cluster rebalance", [
         ("dryrun", "boolean", "propose only (default true)"),
         ("goals", "string", "comma list of goal names"),

@@ -250,6 +250,20 @@ METRICS_JSON_SCHEMA = {
     "properties": {"sensors": {"type": "object"}},
 }
 
+COMPILE_CACHE_SCHEMA = {
+    "type": "object",
+    "required": ["policy", "telemetry"],
+    "properties": {
+        "policy": {"type": "object"},
+        "chunking_enabled": {"type": "boolean"},
+        "warmup_enabled": {"type": "boolean"},
+        "compiled_lane_widths": {"type": "object"},
+        "persistent_cache": {"type": "object"},
+        "telemetry": {"type": "object"},
+        "warmup": {"type": ["object", "null"]},
+    },
+}
+
 ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "state": STATE_SCHEMA,
     "load": LOAD_SCHEMA,
@@ -272,4 +286,5 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "review": REVIEW_BOARD_SCHEMA,
     "admin": ADMIN_SCHEMA,
     "metrics": METRICS_JSON_SCHEMA,
+    "compile_cache": COMPILE_CACHE_SCHEMA,
 }

@@ -42,8 +42,10 @@ class Role(Enum):
 
 _RANK = {Role.VIEWER: 0, Role.USER: 1, Role.ADMIN: 2}
 
-# DefaultRoleSecurityProvider.java:50-62.
-_VIEWER_GET = {"kafka_cluster_state", "user_tasks", "review_board", "metrics"}
+# DefaultRoleSecurityProvider.java:50-62.  compile_cache rides the VIEWER
+# tier like metrics: it is pure observability (no cluster data beyond shapes).
+_VIEWER_GET = {"kafka_cluster_state", "user_tasks", "review_board", "metrics",
+               "compile_cache"}
 _ADMIN_GET = {"bootstrap", "train"}
 
 

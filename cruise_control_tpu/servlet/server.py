@@ -44,7 +44,7 @@ USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics"}
+                 "metrics", "compile_cache"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -246,6 +246,16 @@ class CruiseControlApp:
         if _bool(params, "json", False):
             return 200, {"sensors": registry().snapshot()}, {}
         return 200, registry().prometheus_text(), {}
+
+    def _ep_compile_cache(self, params, task_id):
+        """Compile-service admin view: bucket policy, compiled lane widths,
+        persistent-cache state, warmup progress, per-bucket hit/miss/compile
+        counters (the raw sensors also ride /metrics)."""
+        from cruise_control_tpu.compilesvc import compile_service
+        body = compile_service().snapshot()
+        daemon = getattr(self.cc, "warmup_daemon", None)
+        body["warmup"] = daemon.snapshot() if daemon is not None else None
+        return 200, body, {}
 
     def _ep_partition_load(self, params, task_id):
         n = int(params.get("entries", "100"))

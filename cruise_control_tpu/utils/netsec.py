@@ -45,7 +45,13 @@ def client_ssl_context(cafile: Optional[str] = None):
         ctx = ssl.create_default_context(cafile=cafile)
         ctx.check_hostname = False
     else:
-        ctx = ssl._create_unverified_context()  # noqa: S323 — opt-in
+        # Public-API equivalent of the former ssl._create_unverified_context()
+        # call: encrypted-but-unverified, built from documented knobs only
+        # (the private helper's behavior is not a stable contract across
+        # Python releases).
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
     return ctx
 
 

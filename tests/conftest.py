@@ -26,6 +26,17 @@ force_cpu(n_devices=8)
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _reset_compile_service():
+    """A module that installs a configured CompileService (main.build_app,
+    compilesvc tests) must not leak it — warmup/chunking flags would bleed
+    into unrelated modules' facade and optimizer runs."""
+    yield
+    from cruise_control_tpu.compilesvc import set_compile_service
+
+    set_compile_service(None)
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _bound_resident_xla_executables():
     """XLA:CPU segfaults inside ``backend_compile_and_load`` once a single
     process accumulates enough compiled executables (reproduced twice at the

@@ -1,0 +1,76 @@
+"""Drift guards for bench.py: the benchmark's goal stack must be the
+registry's, byte for byte — config #4's "full default stack" claim is only
+comparable across rounds if a registry change cannot silently diverge from
+what the bench actually times.  Also covers the bench's pure helpers
+(``--only`` parsing, derived compile fields, quality extraction)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goals.registry import (
+    DEFAULT_GOALS,
+    DEFAULT_HARD_GOALS,
+)
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)   # no jax import at module level
+    return mod
+
+
+bench = _load_bench()
+
+
+def test_bench_goals_match_registry_default_goals():
+    assert bench.GOALS == DEFAULT_GOALS
+
+
+def test_bench_hard_goals_match_registry_hard_goals():
+    assert bench.HARD_GOALS == DEFAULT_HARD_GOALS
+
+
+def test_parse_only_absent_and_valid():
+    assert bench._parse_only(["bench.py"]) is None
+    assert bench._parse_only(["bench.py", "--only", "3"]) == {3}
+    assert bench._parse_only(["bench.py", "--only", "1,5"]) == {1, 5}
+
+
+@pytest.mark.parametrize("argv", [
+    ["bench.py", "--only"],             # missing argument
+    ["bench.py", "--only", "x"],        # non-numeric
+    ["bench.py", "--only", "1,,x"],     # partially numeric
+])
+def test_parse_only_rejects_bad_argv(argv):
+    with pytest.raises(SystemExit) as exc:
+        bench._parse_only(argv)
+    assert exc.value.code == 2
+
+
+def test_compile_fields_are_derived_from_the_counter_delta():
+    assert bench._compile_fields(0) == {
+        "fresh_compiles": 0, "includes_compile": False,
+        "compile_cache": "warm"}
+    assert bench._compile_fields(3) == {
+        "fresh_compiles": 3, "includes_compile": True,
+        "compile_cache": "cold"}
+
+
+def test_batch_quality_reports_total_and_worst_lane():
+    class FakeBatch:
+        num_scenarios = 3
+        violated_after = np.array([[0, 0], [2, 1], [0, 0]])
+
+        def balancedness(self, s):
+            return [100.0, 25.0, 100.0][s]
+
+    q = bench._batch_quality(FakeBatch())
+    assert q == {"violated_after": 3, "balancedness": 25.0}

@@ -1,0 +1,434 @@
+"""compilesvc: shape buckets, lane chunking, persistent cache, warmup.
+
+The integration tests at the bottom drive the REAL solver and assert on the
+compile telemetry — "zero recompiles" means the ``CompileService.compile-
+count`` sensor did not move, which is the subsystem's whole point.
+
+NOTE: the persistent-cache tests point JAX's compilation-cache config at a
+tmp_path and restore it afterwards — the suite must never leave a
+persistent CPU cache active (tests/conftest.py SIGILL warning).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.compilesvc import (
+    CompileService,
+    LaneChunk,
+    PersistentCompileCache,
+    ShapeBucketPolicy,
+    WarmupDaemon,
+    compile_service,
+    plan_lane_chunks,
+    set_compile_service,
+    telemetry,
+)
+from cruise_control_tpu.compilesvc.buckets import (
+    DEFAULT_LANE_LADDER,
+    geometric_bucket,
+    ladder_bucket,
+)
+from cruise_control_tpu.compilesvc.cache import (
+    SCHEMA_VERSION,
+    jaxlib_version,
+    machine_fingerprint,
+)
+from cruise_control_tpu.compilesvc.service import goal_stack_hash
+
+
+@pytest.fixture
+def fresh_service():
+    """Swap in a default process service and reset it afterwards."""
+    set_compile_service(None)
+    yield compile_service()
+    set_compile_service(None)
+
+
+@pytest.fixture
+def jax_cache_config_guard():
+    """Snapshot/restore the JAX persistent-cache config keys that
+    ``PersistentCompileCache.activate`` mutates."""
+    import jax
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_entry_size_bytes",
+            "jax_persistent_cache_min_compile_time_secs")
+    before = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in before.items():
+        jax.config.update(k, v)
+
+
+# ---------------------------------------------------------------- buckets
+
+def test_geometric_bucket_grows_from_floor():
+    assert geometric_bucket(1, 64, 2.0) == 64
+    assert geometric_bucket(64, 64, 2.0) == 64
+    assert geometric_bucket(65, 64, 2.0) == 128
+    assert geometric_bucket(129, 64, 2.0) == 256
+
+
+def test_ladder_bucket_snaps_up():
+    assert ladder_bucket(1, (1, 2, 4, 8)) == 1
+    assert ladder_bucket(3, (1, 2, 4, 8)) == 4
+    assert ladder_bucket(9, (1, 2, 4, 8)) == 8    # above the top rung: cap
+
+
+def test_pad_targets_round_trip():
+    policy = ShapeBucketPolicy()
+    # Historical facade floors: small clusters land on the legacy shapes.
+    assert policy.pad_targets(1, 1) == (64, 8)
+    assert policy.pad_targets(100, 5) == (128, 8)
+    assert policy.pad_targets(65, 9) == (128, 16)
+    for n_r in (1, 63, 64, 65, 100, 511, 512, 513):
+        for n_b in (1, 8, 9, 100):
+            r, b = policy.pad_targets(n_r, n_b)
+            assert r >= n_r and b >= n_b
+            # Idempotent: a bucket is its own bucket (stable cache keys).
+            assert policy.pad_targets(r, b) == (r, b)
+
+
+def test_bucket_label_format():
+    policy = ShapeBucketPolicy()
+    assert policy.bucket_label(512, 64) == "R512-C64"
+    assert policy.bucket_label(512, 64, lanes=16) == "R512-C64-L16"
+
+
+def test_freeze_at_bucketed_targets_yields_bucket_shapes():
+    from cruise_control_tpu.testing import deterministic as det
+    policy = ShapeBucketPolicy()
+    cm = det.homogeneous_cluster({0: 0, 1: 0, 2: 1, 3: 1, 4: 2, 5: 2})
+    for p in range(30):
+        cm.create_replica("T1", p, broker_id=p % 6, index=0, is_leader=True)
+        cm.set_replica_load("T1", p, p % 6, det.load(0.1, 1.0, 1.0, 1.0))
+    r_pad, b_pad = policy.pad_targets(30, 6)
+    assert (r_pad, b_pad) == (64, 8)
+    state, _placement, _meta = cm.freeze(pad_replicas_to=r_pad,
+                                         pad_brokers_to=b_pad)
+    # freeze pads to the next MULTIPLE; a bucket >= n pads to exactly it.
+    assert state.num_replicas_padded == r_pad
+    assert len(state.alive) == b_pad
+
+
+# --------------------------------------------------------------- chunking
+
+def test_plan_spec_example_cold():
+    # ISSUE spec: 70 lanes, nothing compiled -> 4x16 + one 8-wide tail
+    # carrying 6 real lanes.
+    plan = plan_lane_chunks(70, DEFAULT_LANE_LADDER, compiled=(),
+                            max_chunk=16)
+    assert plan[:4] == [LaneChunk(16, 0, 16), LaneChunk(16, 16, 16),
+                        LaneChunk(16, 32, 16), LaneChunk(16, 48, 16)]
+    assert plan[4] == LaneChunk(8, 64, 6)
+
+
+def test_plan_64_through_16s():
+    plan = plan_lane_chunks(64, DEFAULT_LANE_LADDER, compiled={16},
+                            max_chunk=16)
+    assert plan == [LaneChunk(16, s, 16) for s in (0, 16, 32, 48)]
+
+
+def test_plan_reuses_compiled_width_for_ragged_tail():
+    # With a 16-wide executable already compiled, riding it for the 6-lane
+    # tail beats compiling a fresh 8-wide program.
+    plan = plan_lane_chunks(70, DEFAULT_LANE_LADDER, compiled={16},
+                            max_chunk=16)
+    assert plan[4] == LaneChunk(16, 64, 6)
+
+
+def test_plan_covers_every_lane_exactly_once():
+    for n in (1, 2, 5, 16, 17, 63, 64, 70, 100):
+        for compiled in ((), {4}, {16}, {4, 16}):
+            plan = plan_lane_chunks(n, DEFAULT_LANE_LADDER,
+                                    compiled=compiled, max_chunk=16)
+            assert sum(c.n_real for c in plan) == n
+            pos = 0
+            for c in plan:
+                assert c.start == pos
+                assert 1 <= c.n_real <= c.size <= 16
+                pos += c.n_real
+
+
+def test_plan_identity_when_chunking_disabled():
+    svc = CompileService(chunking_enabled=False)
+    assert svc.plan_lanes(70) == [LaneChunk(70, 0, 70)]
+
+
+def test_lane_registry_round_trip():
+    svc = CompileService()
+    key = svc.lane_key(["RackAwareGoal"], 512, 16, 64)
+    assert svc.compiled_lane_widths(key) == set()
+    svc.note_lanes_compiled(key, 16)
+    svc.note_lanes_compiled(key, 16)
+    svc.note_lanes_compiled(key, 8)
+    assert svc.compiled_lane_widths(key) == {8, 16}
+    # Key is goal-stack sensitive: another stack sees nothing.
+    other = svc.lane_key(["ReplicaCapacityGoal"], 512, 16, 64)
+    assert svc.compiled_lane_widths(other) == set()
+
+
+# ----------------------------------------------------------------- cache
+
+def test_cache_dir_carries_every_version_axis(tmp_path):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    stack = goal_stack_hash(["RackAwareGoal"])
+    path = cache.cache_dir("cpu", stack, "R512-C64")
+    parts = os.path.relpath(path, str(tmp_path)).split(os.sep)
+    assert parts == [f"v{SCHEMA_VERSION}",
+                     f"cpu-{machine_fingerprint()}",
+                     f"jaxlib-{jaxlib_version()}", stack, "R512-C64"]
+
+
+def test_cache_activate_cold_then_warm(tmp_path, jax_cache_config_guard):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    assert cache.activate("cpu", "stackA", "R64-C64") is False
+    assert cache.active_dir is not None
+    # Simulate an XLA write-through, then a fresh process at the same key.
+    with open(os.path.join(cache.active_dir, "xla_entry.bin"), "wb") as f:
+        f.write(b"\x00" * 64)
+    cache2 = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    assert cache2.activate("cpu", "stackA", "R64-C64") is True
+    assert cache2.stats()["entries"] == 1
+    # A different goal stack or bucket is a different (cold) directory.
+    assert cache2.activate("cpu", "stackB", "R64-C64") is False
+    assert cache2.activate("cpu", "stackA", "R128-C64") is False
+
+
+def test_cache_quarantines_unreadable_manifest(tmp_path,
+                                               jax_cache_config_guard):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    path = cache.cache_dir("cpu", "stackA", "R64-C64")
+    os.makedirs(path)
+    with open(os.path.join(path, "cc-cache-manifest.json"), "w") as f:
+        f.write("{not json")
+    with open(os.path.join(path, "xla_entry.bin"), "wb") as f:
+        f.write(b"\x00" * 64)
+    assert cache.activate("cpu", "stackA", "R64-C64") is False
+    assert os.path.isdir(path + ".quarantined")
+    assert os.path.exists(
+        os.path.join(path + ".quarantined", "xla_entry.bin"))
+    # The recreated directory holds a fresh, valid manifest.
+    with open(os.path.join(path, "cc-cache-manifest.json")) as f:
+        assert json.load(f)["schema"] == SCHEMA_VERSION
+
+
+def test_cache_quarantines_version_mismatch(tmp_path,
+                                            jax_cache_config_guard):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    path = cache.cache_dir("cpu", "stackA", "R64-C64")
+    os.makedirs(path)
+    with open(os.path.join(path, "cc-cache-manifest.json"), "w") as f:
+        json.dump({"schema": SCHEMA_VERSION, "jaxlib": "0.0.0",
+                   "fingerprint": machine_fingerprint()}, f)
+    with open(os.path.join(path, "xla_entry.bin"), "wb") as f:
+        f.write(b"\x00" * 64)
+    assert cache.activate("cpu", "stackA", "R64-C64") is False
+    assert os.path.isdir(path + ".quarantined")
+
+
+def test_cache_disabled_is_inert(tmp_path):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=False)
+    assert cache.activate("cpu") is False
+    assert cache.active_dir is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_cache_evicts_oldest_first(tmp_path):
+    cache = PersistentCompileCache(root=str(tmp_path), max_bytes=150,
+                                   enabled=True)
+    old = tmp_path / "old.bin"
+    new = tmp_path / "new.bin"
+    old.write_bytes(b"\x00" * 100)
+    new.write_bytes(b"\x00" * 100)
+    past = time.time() - 3600
+    os.utime(old, (past, past))
+    removed = cache.evict(str(tmp_path))
+    assert removed == 100
+    assert not old.exists() and new.exists()
+
+
+# ---------------------------------------------------------------- warmup
+
+def test_warmup_duplicate_key_runs_once():
+    calls = []
+    d = WarmupDaemon()
+    d.add_task("k1", lambda: calls.append(1))
+    d.add_task("k1", lambda: calls.append(2))
+    d.start()
+    d.join(timeout=10)
+    assert calls == [1]
+    assert d.snapshot()["state"] == "done"
+    assert d.warmed_keys() == {"k1"}
+
+
+def test_warmup_restart_skips_warmed_keys():
+    calls = []
+    d = WarmupDaemon()
+    d.add_task("k1", lambda: calls.append(1))
+    d.start()
+    d.join(timeout=10)
+    d.start()                      # restart after completion
+    d.join(timeout=10)
+    assert calls == [1]
+
+
+def test_warmup_errors_are_captured_not_raised():
+    def boom():
+        raise RuntimeError("no backend")
+    ran = []
+    d = WarmupDaemon()
+    d.add_task("bad", boom)
+    d.add_task("good", lambda: ran.append(1))
+    d.start()
+    d.join(timeout=10)
+    snap = d.snapshot()
+    assert snap["state"] == "done"
+    assert ran == [1]
+    assert len(snap["errors"]) == 1 and "no backend" in snap["errors"][0]
+
+
+def test_warmup_stop_aborts_between_tasks():
+    release = threading.Event()
+    ran = []
+    d = WarmupDaemon()
+    d.add_task("slow", lambda: release.wait(10))
+    d.add_task("never", lambda: ran.append(1))
+    d.start()
+    d._stop.set()                  # request stop while task 1 is in flight
+    release.set()
+    d.join(timeout=10)
+    assert d.snapshot()["state"] == "stopped"
+    assert ran == []
+
+
+# --------------------------------------------------------------- service
+
+def test_configure_reads_compile_keys(fresh_service):
+    from cruise_control_tpu.compilesvc import configure
+    from cruise_control_tpu.config import CruiseControlConfig
+    cfg = CruiseControlConfig({
+        "compile.replica.pad.floor": "128",
+        "compile.max.lane.bucket": "8",
+        "compile.warmup.enabled": "false",
+        "compile.persistent.cache.max.bytes": "1024",
+    })
+    svc = configure(cfg)
+    assert svc is compile_service()
+    assert svc.policy.replica_floor == 128
+    assert svc.policy.max_lane_bucket == 8
+    assert svc.warmup_enabled is False
+    assert svc.cache.max_bytes == 1024
+    # Persistent cache stays OFF unless explicitly opted in (XLA:CPU
+    # cross-process SIGILL hazard — see conftest.py).
+    assert svc.cache.enabled is False
+
+
+def test_configure_defaults(fresh_service):
+    from cruise_control_tpu.compilesvc import configure
+    from cruise_control_tpu.config import CruiseControlConfig
+    svc = configure(CruiseControlConfig({}))
+    assert svc.policy.replica_floor == 64
+    assert svc.policy.broker_floor == 8
+    assert svc.chunking_enabled is True
+    assert svc.warmup_enabled is True
+    assert svc.warmup_lanes == 4
+
+
+def test_snapshot_matches_admin_schema(fresh_service):
+    from cruise_control_tpu.servlet.schemas import (COMPILE_CACHE_SCHEMA,
+                                                    validate)
+    svc = fresh_service
+    svc.note_lanes_compiled(svc.lane_key(["RackAwareGoal"], 64, 8, 64), 4)
+    body = svc.snapshot()
+    body["warmup"] = WarmupDaemon().snapshot()
+    validate(body, COMPILE_CACHE_SCHEMA)
+    validate({**svc.snapshot(), "warmup": None}, COMPILE_CACHE_SCHEMA)
+
+
+def test_goal_stack_hash_is_order_sensitive():
+    a = goal_stack_hash(["A", "B"])
+    assert a == goal_stack_hash(["A", "B"])
+    assert a != goal_stack_hash(["B", "A"])
+    assert len(a) == 12
+
+
+# ------------------------------------------------------------ integration
+
+def _tiny_cluster(n_partitions):
+    from cruise_control_tpu.testing import deterministic as det
+    cm = det.homogeneous_cluster({0: 0, 1: 0, 2: 1, 3: 1, 4: 2, 5: 2})
+    for p in range(n_partitions):
+        lead, foll = p % 6, (p + 1 + p % 3) % 6
+        cm.create_replica("T1", p, broker_id=lead, index=0, is_leader=True)
+        cm.create_replica("T1", p, broker_id=foll, index=1, is_leader=False)
+        cm.set_replica_load("T1", p, lead, det.load(0.2, 10.0, 12.0, 20.0))
+        cm.set_replica_load("T1", p, foll, det.load(0.05, 10.0, 0.0, 20.0))
+    return cm
+
+
+def test_second_solve_in_same_bucket_is_zero_recompiles(fresh_service):
+    """The subsystem's acceptance property: two snapshots with different
+    raw replica counts that land in the SAME shape bucket share every
+    executable — the compile sensor must not move on the second solve."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    svc = fresh_service
+    opt = GoalOptimizer(goal_names=["RackAwareGoal", "ReplicaCapacityGoal"])
+
+    def solve(n_partitions):
+        cm = _tiny_cluster(n_partitions)
+        r_pad, b_pad = svc.pad_targets(2 * n_partitions, 6)
+        state, placement, meta = cm.freeze(pad_replicas_to=r_pad,
+                                           pad_brokers_to=b_pad)
+        return opt.optimizations(state, placement, meta)
+
+    # 20 and 25 partitions -> 40 vs 50 replicas, both bucket R64.
+    assert svc.pad_targets(40, 6) == svc.pad_targets(50, 6)
+    solve(20)
+    before = telemetry().compile_count()
+    result = solve(25)
+    assert telemetry().compile_count() == before
+    assert result.balancedness_score >= 0.0
+
+
+def test_chunked_batch_matches_unchunked(fresh_service):
+    """Routing a lane batch through smaller compiled widths must be
+    invisible in the results (vmap lanes are independent)."""
+    import numpy as np
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    cm = _tiny_cluster(24)
+    state, placement, meta = cm.freeze(pad_replicas_to=64, pad_brokers_to=8)
+    sets = [[0], [1], [2], [3], [4], [5], [0, 1], [2, 3]]
+
+    # Chunked: cap lane buckets at 4 so the 8-lane batch becomes 2x4.
+    svc = CompileService(policy=ShapeBucketPolicy(max_lane_bucket=4))
+    set_compile_service(svc)
+    opt = GoalOptimizer(goal_names=["RackAwareGoal", "ReplicaCapacityGoal"])
+    chunked = opt.batch_remove_scenarios(state, placement, meta, sets,
+                                         num_candidates=64)
+    key = svc.lane_key(["RackAwareGoal", "ReplicaCapacityGoal"],
+                       state.num_replicas_padded, len(state.alive), 64)
+    assert svc.compiled_lane_widths(key) == {4}
+
+    # Unchunked reference (identity plan).
+    set_compile_service(CompileService(chunking_enabled=False))
+    opt2 = GoalOptimizer(goal_names=["RackAwareGoal", "ReplicaCapacityGoal"])
+    plain = opt2.batch_remove_scenarios(state, placement, meta, sets,
+                                        num_candidates=64)
+
+    np.testing.assert_array_equal(chunked.violated_after,
+                                  plain.violated_after)
+    np.testing.assert_array_equal(chunked.moves, plain.moves)
+    np.testing.assert_array_equal(chunked.stranded_after,
+                                  plain.stranded_after)
+    for s in range(len(sets)):
+        a, b = chunked.placement_for(s), plain.placement_for(s)
+        np.testing.assert_array_equal(np.asarray(a.broker),
+                                      np.asarray(b.broker))
+        np.testing.assert_array_equal(np.asarray(a.is_leader),
+                                      np.asarray(b.is_leader))
+        assert chunked.quality(s) == plain.quality(s)

@@ -1,0 +1,80 @@
+"""``${env:VAR}`` config-value indirection: secrets stay out of properties
+files and are resolved from the process environment at load time — in
+``load_properties`` for file-sourced values and in ``ConfigDef._coerce``
+for programmatic overrides."""
+
+from __future__ import annotations
+
+import pytest
+
+from cruise_control_tpu.common.exceptions import ConfigError
+from cruise_control_tpu.config import CruiseControlConfig
+from cruise_control_tpu.config.config_def import (
+    load_properties,
+    resolve_env_refs,
+)
+
+
+def test_plain_values_pass_through():
+    assert resolve_env_refs("plain") == "plain"
+    assert resolve_env_refs("") == ""
+    assert resolve_env_refs(42) == 42
+    assert resolve_env_refs(None) is None
+    assert resolve_env_refs(True) is True
+
+
+def test_single_ref_resolves(monkeypatch):
+    monkeypatch.setenv("CC_TEST_SECRET", "s3cr3t")
+    assert resolve_env_refs("${env:CC_TEST_SECRET}") == "s3cr3t"
+
+
+def test_embedded_and_multiple_refs(monkeypatch):
+    monkeypatch.setenv("CC_TEST_USER", "alice")
+    monkeypatch.setenv("CC_TEST_PW", "hunter2")
+    assert (resolve_env_refs("${env:CC_TEST_USER}:${env:CC_TEST_PW}@host")
+            == "alice:hunter2@host")
+
+
+def test_unset_var_is_a_config_error(monkeypatch):
+    monkeypatch.delenv("CC_TEST_MISSING", raising=False)
+    with pytest.raises(ConfigError, match="CC_TEST_MISSING"):
+        resolve_env_refs("${env:CC_TEST_MISSING}")
+
+
+def test_malformed_ref_passes_through_verbatim():
+    # Not the documented syntax -> not an indirection (no silent surprises).
+    assert resolve_env_refs("${envCC_X}") == "${envCC_X}"
+    assert resolve_env_refs("$env:CC_X") == "$env:CC_X"
+
+
+def test_load_properties_resolves_secrets(tmp_path, monkeypatch):
+    monkeypatch.setenv("CC_TEST_WEBHOOK_TOKEN", "tok-123")
+    path = tmp_path / "cc.properties"
+    path.write_text(
+        "# comment\n"
+        "compile.persistent.cache.path=${env:CC_TEST_WEBHOOK_TOKEN}\n"
+        "compile.warmup.lanes=8\n")
+    props = load_properties(str(path))
+    assert props["compile.persistent.cache.path"] == "tok-123"
+    assert props["compile.warmup.lanes"] == "8"
+
+
+def test_load_properties_unset_secret_fails_loud(tmp_path, monkeypatch):
+    monkeypatch.delenv("CC_TEST_MISSING", raising=False)
+    path = tmp_path / "cc.properties"
+    path.write_text("compile.persistent.cache.path=${env:CC_TEST_MISSING}\n")
+    with pytest.raises(ConfigError, match="CC_TEST_MISSING"):
+        load_properties(str(path))
+
+
+def test_programmatic_overrides_get_the_same_indirection(monkeypatch):
+    # Dict-passed values go through ConfigDef._coerce, including coercion
+    # of a numeric secret to its declared type.
+    monkeypatch.setenv("CC_TEST_CACHE_DIR", "/var/cache/cc")
+    monkeypatch.setenv("CC_TEST_MAX_BYTES", "1048576")
+    cfg = CruiseControlConfig({
+        "compile.persistent.cache.path": "${env:CC_TEST_CACHE_DIR}",
+        "compile.persistent.cache.max.bytes": "${env:CC_TEST_MAX_BYTES}",
+    })
+    assert cfg.get("compile.persistent.cache.path") == "/var/cache/cc"
+    assert cfg.get("compile.persistent.cache.max.bytes") == 1048576
