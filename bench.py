@@ -34,6 +34,12 @@ satisfied-goal score, [0,100]), plus ``fresh_compiles`` /
 ``includes_compile`` / ``compile_cache`` derived from the compilesvc
 telemetry's compile counter around the timed region — the labels are
 measured, not asserted.
+
+``--trace`` turns on the obsvc span tracer for the run and attaches each
+row's per-phase rollup (``{phase: {count, total_ms, mean_ms}}``, drained
+per row) as a ``trace`` field — per-goal wall plus the solver's fenced
+``device_ms`` attribution ride along, at the cost of a block_until_ready
+fence per goal dispatch, so untraced rows stay the comparable series.
 """
 
 from __future__ import annotations
@@ -99,10 +105,21 @@ def _parse_only(argv):
         raw = argv[argv.index("--only") + 1]
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
-        sys.stderr.write("usage: bench.py [--only N[,N...]]  "
+        sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace]  "
                          "(config numbers 1-5, e.g. --only 3 or "
                          "--only 1,5)\n")
         raise SystemExit(2)
+
+
+def _maybe_enable_trace() -> None:
+    """``--trace``: switch the obsvc tracer on for this process so every
+    emitted row carries the per-phase rollup.  Enabled per PROCESS (the TPU
+    child re-enables from its own argv) right before ``run`` so the flag
+    costs nothing when absent."""
+    if "--trace" not in sys.argv:
+        return
+    from cruise_control_tpu.obsvc.tracer import tracer
+    tracer().configure(enabled=True, ring_size=64)
 
 
 def main() -> None:
@@ -136,6 +153,7 @@ def main() -> None:
             svc.cache.activate(platform_name="tpu",
                                goal_stack_hash=goal_stack_hash(GOALS))
         try:
+            _maybe_enable_trace()
             run("tpu", only=only)
         except Exception as e:
             import traceback
@@ -146,6 +164,8 @@ def main() -> None:
 
     only_args = (["--only", sys.argv[sys.argv.index("--only") + 1]]
                  if only is not None else [])
+    if "--trace" in sys.argv:
+        only_args.append("--trace")     # child re-reads its own argv
     backend = select_backend()
     if backend == "tpu":
         # The tunneled TPU backend can hang MID-RUN (not just at init) — a
@@ -171,20 +191,28 @@ def main() -> None:
             sys.stderr.write("\ntpu child timed out; falling back to cpu\n")
     from cruise_control_tpu.utils.hermetic import force_cpu
     force_cpu()
+    _maybe_enable_trace()
     run("cpu", only=only)
 
 
 def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
     """One JSON line; ``vs_baseline`` is ALWAYS budget/value (whole
     measurement) so the field stays comparable across metrics and rounds."""
-    print(json.dumps({
+    row = {
         "metric": metric,
         "value": round(seconds, 4),
         "unit": "seconds",
         "vs_baseline": round(NORTH_STAR_BUDGET_S / max(seconds, 1e-9), 3),
         "backend": backend,
         **extra,
-    }), flush=True)
+    }
+    from cruise_control_tpu.obsvc.tracer import tracer
+    tr = tracer()
+    if tr.enabled:
+        # Drained per row: each row's rollup covers only the phases since
+        # the previous row (warmup calls included — honest attribution).
+        row["trace"] = tr.rollup(reset=True)
+    print(json.dumps(row), flush=True)
 
 
 def _compile_fields(fresh: int) -> dict:

@@ -35,6 +35,8 @@ from cruise_control_tpu.analyzer.solver import (
 )
 from cruise_control_tpu.common.actions import ExecutionProposal, ProposalSummary
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
+from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_telemetry
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
 
@@ -270,6 +272,26 @@ class GoalOptimizer:
     ) -> OptimizerResult:
         """The core loop (GoalOptimizer.java:415-489): per-goal optimize with
         all previously-optimized goals enforcing acceptance, then diff."""
+        tr = _obsvc_tracer()
+        if not tr.enabled:
+            return self._optimizations_impl(state, placement, meta, options,
+                                            goals, model_generation)
+        n = len(goals) if goals is not None else len(self.goal_names)
+        with tr.span("optimize", num_goals=n, generation=model_generation):
+            return self._optimizations_impl(state, placement, meta, options,
+                                            goals, model_generation)
+
+    def _optimizations_impl(
+        self,
+        state: ClusterState,
+        placement: Placement,
+        meta: ClusterMeta,
+        options: Optional[OptimizationOptions] = None,
+        goals: Optional[Sequence[Goal]] = None,
+        model_generation: Optional[int] = None,
+    ) -> OptimizerResult:
+        tr = _obsvc_tracer()
+        tel = _compile_telemetry()
         options = options or OptimizationOptions()
         cache_key = None
         if model_generation is not None:
@@ -316,9 +338,21 @@ class GoalOptimizer:
         infos: List[GoalOptimizationInfo] = []
         priors: List[Goal] = []
         agg = agg0
+        bucket = f"R{gctx.state.num_replicas_padded}"
         for goal in goals:
-            placement, agg, info = self.solver.optimize_goal(
-                goal, priors, gctx, placement, agg)
+            # One span per goal per optimization round: moves + rounds from
+            # the solve, compile-vs-execute split from compilesvc telemetry
+            # deltas (execute_ms materializes at render time as
+            # wall_ms - compile_ms).
+            with tr.span(f"goal.{goal.name}", bucket=bucket) as gsp:
+                c0, s0 = tel.compile_count(), tel.compile_seconds_total()
+                placement, agg, info = self.solver.optimize_goal(
+                    goal, priors, gctx, placement, agg)
+                gsp.set("rounds", info.rounds)
+                gsp.set("moves", info.moves_applied)
+                gsp.set("fresh_compiles", tel.compile_count() - c0)
+                gsp.set("compile_ms", round(
+                    (tel.compile_seconds_total() - s0) * 1000.0, 3))
             infos.append(info)
             stranded = 0
             if goal.is_hard and goal.uses_replica_moves:
@@ -365,9 +399,16 @@ class GoalOptimizer:
             if not revio:
                 break
             for goal in revio:
-                placement, agg, pinfo = self.solver.optimize_goal(
-                    goal, [p for p in goals if p is not goal], gctx, placement,
-                    agg)
+                with tr.span(f"polish.{goal.name}", bucket=bucket) as psp:
+                    c0, s0 = tel.compile_count(), tel.compile_seconds_total()
+                    placement, agg, pinfo = self.solver.optimize_goal(
+                        goal, [p for p in goals if p is not goal], gctx,
+                        placement, agg)
+                    psp.set("rounds", pinfo.rounds)
+                    psp.set("moves", pinfo.moves_applied)
+                    psp.set("fresh_compiles", tel.compile_count() - c0)
+                    psp.set("compile_ms", round(
+                        (tel.compile_seconds_total() - s0) * 1000.0, 3))
                 for i, inf in enumerate(infos):
                     if inf.goal_name == goal.name:
                         inf.rounds += pinfo.rounds

@@ -55,6 +55,7 @@ from cruise_control_tpu.analyzer.context import (
 from cruise_control_tpu.analyzer.goals.base import Goal
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
 from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_telemetry
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import Placement
 
@@ -1231,8 +1232,23 @@ class GoalSolver:
         solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
         if agg is None:
             agg = self.aggregates(gctx, placement)
+        tr = _obsvc_tracer()
+        if tr.enabled:
+            # Fence the dispatch so device time lands on THIS span instead
+            # of whichever later host sync happens to block: annotate the
+            # XLA timeline for /profile captures, then block on the full
+            # output pytree before reading the clock.
+            t0 = time.monotonic()
+            with jax.profiler.TraceAnnotation(f"cc.solve.{goal.name}"):
+                out = jax.block_until_ready(solve(gctx, placement, agg))
+            span = tr.current()
+            if span is not None:
+                span.add_ms("device_ms",
+                            round((time.monotonic() - t0) * 1000.0, 3))
+        else:
+            out = solve(gctx, placement, agg)
         (placement, agg, rounds, moves, violated, stranded, metric, violated0,
-         metric0) = solve(gctx, placement, agg)
+         metric0) = out
         info = GoalOptimizationInfo(
             goal_name=goal.name,
             rounds=int(rounds),

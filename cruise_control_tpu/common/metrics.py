@@ -27,16 +27,26 @@ from typing import Callable, Dict, List, Optional
 
 _RATE_WINDOW_S = 60.0
 
+SCRAPE_ERRORS_SENSOR = "MetricRegistry.sensor-scrape-errors"
+
+
+def _sanitize(name: str) -> str:
+    """The Prometheus-name mapping used by ``prometheus_text``'s clean()."""
+    return "".join(ch if ch.isalnum() else "_" for ch in name)
+
 
 class Counter:
     def __init__(self):
         self._lock = threading.Lock()
         self._count = 0
         self._events: deque = deque()
+        self._first_ts: Optional[float] = None
 
     def inc(self, n: int = 1) -> None:
         now = time.monotonic()
         with self._lock:
+            if self._first_ts is None:
+                self._first_ts = now
             self._count += n
             self._events.append((now, n))
             self._trim(now)
@@ -51,11 +61,20 @@ class Counter:
             return self._count
 
     def rate(self) -> float:
-        """Events per second over the trailing minute."""
+        """Events per second over the trailing minute.
+
+        Young counters divide by the observed lifetime (floored at 1 s so
+        a same-millisecond burst doesn't explode), not the full window —
+        dividing N first-second events by 60 under-reported early rates
+        60x and made fresh-boot scrapes look idle.
+        """
         now = time.monotonic()
         with self._lock:
             self._trim(now)
-            return sum(n for _, n in self._events) / _RATE_WINDOW_S
+            if self._first_ts is None:
+                return 0.0
+            window = min(_RATE_WINDOW_S, max(now - self._first_ts, 1.0))
+            return sum(n for _, n in self._events) / window
 
 
 class SettableGauge:
@@ -113,22 +132,57 @@ class MetricRegistry:
         self._timers: Dict[str, Timer] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._settable: Dict[str, SettableGauge] = {}
+        # sanitized prometheus name → (sensor name, kind): two sensors that
+        # collapse to one series after clean() would silently shadow each
+        # other in /metrics, so collisions fail loudly at registration.
+        self._prom_names: Dict[str, tuple] = {}
+
+    def _register_guard(self, name: str, kind: str) -> None:
+        # Caller holds self._lock.
+        key = _sanitize(name)
+        prior = self._prom_names.get(key)
+        if prior is None:
+            self._prom_names[key] = (name, kind)
+            return
+        prior_name, prior_kind = prior
+        if prior_name != name:
+            raise ValueError(
+                f"sensor name {name!r} collides with {prior_name!r}: both "
+                f"sanitize to Prometheus series {key!r}")
+        if prior_kind != kind:
+            raise ValueError(
+                f"sensor {name!r} already registered as a {prior_kind}, "
+                f"cannot re-register as a {kind}")
 
     def counter(self, name: str) -> Counter:
         with self._lock:
-            return self._counters.setdefault(name, Counter())
+            c = self._counters.get(name)
+            if c is None:
+                self._register_guard(name, "counter")
+                c = self._counters[name] = Counter()
+            return c
 
     def timer(self, name: str) -> Timer:
         with self._lock:
-            return self._timers.setdefault(name, Timer())
+            t = self._timers.get(name)
+            if t is None:
+                self._register_guard(name, "timer")
+                t = self._timers[name] = Timer()
+            return t
 
     def gauge(self, name: str, fn: Callable[[], float]) -> None:
         with self._lock:
+            if name not in self._gauges:
+                self._register_guard(name, "gauge")
             self._gauges[name] = fn
 
     def settable_gauge(self, name: str, initial: float = 0.0) -> SettableGauge:
         with self._lock:
-            return self._settable.setdefault(name, SettableGauge(initial))
+            g = self._settable.get(name)
+            if g is None:
+                self._register_guard(name, "settable_gauge")
+                g = self._settable[name] = SettableGauge(initial)
+            return g
 
     def names(self) -> List[str]:
         with self._lock:
@@ -140,10 +194,25 @@ class MetricRegistry:
     def snapshot(self) -> Dict[str, Dict]:
         """name → {type, ...values}; gauge callbacks are sampled now."""
         out: Dict[str, Dict] = {}
+        # Gauges sample first: a raising callback bumps the scrape-errors
+        # counter, and copying counters afterwards means the bump is
+        # visible in this same snapshot rather than the next one.
+        with self._lock:
+            gauges = dict(self._gauges)
+        gauge_records: Dict[str, Dict] = {}
+        scrape_errors = 0
+        for name, fn in gauges.items():
+            try:
+                gauge_records[name] = {"type": "gauge", "value": fn()}
+            except Exception as e:   # noqa: BLE001 — one bad gauge ≠ no metrics
+                gauge_records[name] = {"type": "gauge", "error": str(e)}
+                scrape_errors += 1
+        err_counter = self.counter(SCRAPE_ERRORS_SENSOR)
+        if scrape_errors:
+            err_counter.inc(scrape_errors)
         with self._lock:
             counters = dict(self._counters)
             timers = dict(self._timers)
-            gauges = dict(self._gauges)
             settable = dict(self._settable)
         for name, c in counters.items():
             out[name] = {"type": "counter", "count": c.count,
@@ -151,11 +220,7 @@ class MetricRegistry:
         for name, t in timers.items():
             out[name] = {"type": "timer", **{k: round(v, 4)
                                              for k, v in t.stats().items()}}
-        for name, fn in gauges.items():
-            try:
-                out[name] = {"type": "gauge", "value": fn()}
-            except Exception as e:   # noqa: BLE001 — one bad gauge ≠ no metrics
-                out[name] = {"type": "gauge", "error": str(e)}
+        out.update(gauge_records)
         for name, g in settable.items():
             out[name] = {"type": "gauge", "value": g.value}
         return out
@@ -165,10 +230,7 @@ class MetricRegistry:
         lines: List[str] = []
 
         def clean(name: str) -> str:
-            out = []
-            for ch in name:
-                out.append(ch if ch.isalnum() else "_")
-            return f"{prefix}_{''.join(out)}"
+            return f"{prefix}_{_sanitize(name)}"
 
         for name, record in sorted(self.snapshot().items()):
             base = clean(name)

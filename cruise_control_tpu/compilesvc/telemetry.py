@@ -38,6 +38,10 @@ class CompileTelemetry:
         self._lock = threading.Lock()
         # bucket -> {"compiles": n, "hits": n, "misses": n}
         self._buckets: Dict[str, Dict[str, int]] = {}
+        # Cumulative compile wall-clock: the tracer splits a goal span's
+        # wall time into compile vs execute by delta-ing this across the
+        # solve (the compile-timer's reservoir can't give a reliable delta).
+        self._compile_seconds = 0.0
 
     @property
     def registry(self) -> MetricRegistry:
@@ -64,6 +68,8 @@ class CompileTelemetry:
         self.registry.counter(f"{_PREFIX}.{bucket}.compile-count").inc()
         self.registry.timer(f"{_PREFIX}.compile-timer").update_ms(
             seconds * 1000.0)
+        with self._lock:
+            self._compile_seconds += seconds
         self._bump(bucket, "compiles")
 
     # ------------------------------------------------------------- reads
@@ -76,6 +82,10 @@ class CompileTelemetry:
 
     def miss_count(self) -> int:
         return self.registry.counter(f"{_PREFIX}.cache-miss-count").count
+
+    def compile_seconds_total(self) -> float:
+        with self._lock:
+            return self._compile_seconds
 
     def bucket_table(self) -> Dict[str, Dict[str, int]]:
         with self._lock:

@@ -256,6 +256,26 @@ def _compile_def() -> ConfigDef:
     return d
 
 
+def _trace_def() -> ConfigDef:
+    """obsvc keys (no reference analog — the reference JVM leans on flat
+    Dropwizard sensors; span tracing is this port's solve-time instrument)."""
+    d = ConfigDef()
+    d.define("trace.enabled", ConfigType.BOOLEAN, False,
+             doc="propagate a span tree through every HTTP request, "
+                 "precompute tick and executor batch (GET /trace); adds "
+                 "block_until_ready fences around solver dispatches, so "
+                 "leave off unless attributing time")
+    d.define("trace.ring.size", ConfigType.INT, 32, range_validator(1),
+             doc="how many recent root traces GET /trace retains")
+    d.define("trace.audit.log.size", ConfigType.INT, 256, range_validator(1),
+             doc="bounded length of the self-healing audit log surfaced in "
+                 "the AnomalyDetectorState substate of GET /state")
+    d.define("trace.profile.dir", ConfigType.STRING, "",
+             doc="root directory for POST /profile TensorBoard trace dirs; "
+                 "empty = <tmpdir>/cruise_control_tpu_profiles")
+    return d
+
+
 def _webserver_def() -> ConfigDef:
     d = ConfigDef()
     d.define("webserver.http.port", ConfigType.INT, 9090)
@@ -316,7 +336,8 @@ class CruiseControlConfig:
     def __init__(self, props: Optional[Dict[str, Any]] = None):
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
-                           .merge(_compile_def()).merge(_webserver_def()))
+                           .merge(_compile_def()).merge(_trace_def())
+                           .merge(_webserver_def()))
         props = dict(props or {})
         known = self.definition.keys()
         self.originals = props

@@ -23,6 +23,8 @@ from cruise_control_tpu.detector.notifier import (
     AnomalyNotificationResult,
     NoopNotifier,
 )
+from cruise_control_tpu.obsvc.audit import audit_log
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
 LOG = logging.getLogger(__name__)
 
@@ -170,35 +172,44 @@ class AnomalyDetectorManager:
         return handled
 
     def _handle(self, anomaly: Anomaly) -> None:
+        type_name = anomaly.anomaly_type.name
         action = self.notifier.on_anomaly(anomaly)
         if action.result is AnomalyNotificationResult.IGNORE:
             # Drop the detection timestamp too: id() can be reused after GC
             # and a stale entry would poison mean-time-to-start-fix.
             self._anomaly_detected_s.pop(id(anomaly), None)
             self.state.record(anomaly, "IGNORED")
+            audit_log().record(type_name, anomaly.describe(), "IGNORED")
             return
         if action.result is AnomalyNotificationResult.CHECK:
             with self._qlock:
                 self._check_later.append(
                     (self._clock() + action.delay_ms / 1000.0, anomaly))
             self.state.record(anomaly, "CHECK_WITH_DELAY")
+            audit_log().record(type_name, anomaly.describe(),
+                               "CHECK_WITH_DELAY")
             return
         # FIX
-        self.state.ongoing_self_healing = anomaly.anomaly_type.name
+        entry_id = audit_log().record(type_name, anomaly.describe(), "FIX")
+        self.state.ongoing_self_healing = type_name
         self._self_healing_started.inc()
         detected = self._anomaly_detected_s.pop(id(anomaly), None)
         if detected is not None:
             self._fix_start_timer.update_ms((self._clock() - detected) * 1000.0)
         try:
             ok = False
-            if anomaly.fix is not None:
-                ok = bool(anomaly.fix())
-            elif self._fixer is not None:
-                ok = bool(self._fixer(anomaly))
-            self.state.record(anomaly, "FIX_STARTED" if ok else "FIX_FAILED_TO_START")
+            with _obsvc_tracer().span(f"selfheal.{type_name.lower()}"):
+                if anomaly.fix is not None:
+                    ok = bool(anomaly.fix())
+                elif self._fixer is not None:
+                    ok = bool(self._fixer(anomaly))
+            outcome = "FIX_STARTED" if ok else "FIX_FAILED_TO_START"
+            self.state.record(anomaly, outcome)
+            audit_log().set_outcome(entry_id, outcome)
         except Exception:          # noqa: BLE001 — keep the handler alive
-            LOG.exception("fix for %s failed", anomaly.anomaly_type.name)
+            LOG.exception("fix for %s failed", type_name)
             self.state.record(anomaly, "FIX_FAILED_TO_START")
+            audit_log().set_outcome(entry_id, "FIX_FAILED_TO_START")
         finally:
             self.state.ongoing_self_healing = None
 
@@ -211,4 +222,5 @@ class AnomalyDetectorManager:
             "recentAnomalies": self.state.recent,
             "metrics": self.state.metrics,
             "ongoingSelfHealing": self.state.ongoing_self_healing,
+            "selfHealingAudit": audit_log().entries(),
         }

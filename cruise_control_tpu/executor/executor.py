@@ -29,6 +29,8 @@ from cruise_control_tpu.executor.tasks import (
     ExecutionTaskTracker,
     TaskType,
 )
+from cruise_control_tpu.obsvc.audit import audit_log
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
 LOG = logging.getLogger(__name__)
 # Dedicated operation audit log (reference OPERATION_LOGGER,
@@ -240,6 +242,12 @@ class Executor:
                 self._state = s
 
     def _run(self) -> None:
+        # Root span: the execution thread has no request context, so each
+        # batch is its own trace (phases + outcome counts as attrs).
+        with _obsvc_tracer().span("executor.batch"):
+            self._run_impl()
+
+    def _run_impl(self) -> None:
         try:
             if self._pause_sampling:
                 self._pause_sampling()
@@ -267,20 +275,26 @@ class Executor:
                             self.tracker.transition(
                                 t, ExecutionTaskState.DEAD, self._now_ms())
                     return
+            tr = _obsvc_tracer()
             self._set_state(
                 ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
-            self._move_replicas(TaskType.INTER_BROKER_REPLICA_ACTION,
-                                self._planner.inter_broker_tasks,
-                                self.backend.execute_replica_reassignments,
-                                self.config.concurrent_partition_movements_per_broker)
+            with tr.span("executor.inter-broker"):
+                self._move_replicas(
+                    TaskType.INTER_BROKER_REPLICA_ACTION,
+                    self._planner.inter_broker_tasks,
+                    self.backend.execute_replica_reassignments,
+                    self.config.concurrent_partition_movements_per_broker)
             self._set_state(
                 ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
-            self._move_replicas(TaskType.INTRA_BROKER_REPLICA_ACTION,
-                                self._planner.intra_broker_tasks,
-                                self.backend.execute_logdir_moves,
-                                self.config.concurrent_intra_broker_partition_movements)
+            with tr.span("executor.intra-broker"):
+                self._move_replicas(
+                    TaskType.INTRA_BROKER_REPLICA_ACTION,
+                    self._planner.intra_broker_tasks,
+                    self.backend.execute_logdir_moves,
+                    self.config.concurrent_intra_broker_partition_movements)
             self._set_state(ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS)
-            self._move_leaderships()
+            with tr.span("executor.leadership"):
+                self._move_leaderships()
         finally:
             if self._stop_requested.is_set() and self._planner is not None:
                 for t in self._planner.clear():
@@ -304,13 +318,28 @@ class Executor:
                       for st in (ExecutionTaskState.COMPLETED,
                                  ExecutionTaskState.DEAD,
                                  ExecutionTaskState.ABORTED)}
+            moved_mb = self.tracker.finished_data_movement_mb - base_mb
             OPERATION_LOG.info(
                 "execution finished: completed=%d dead=%d aborted=%d "
                 "moved=%.1fMB",
                 counts[ExecutionTaskState.COMPLETED],
                 counts[ExecutionTaskState.DEAD],
                 counts[ExecutionTaskState.ABORTED],
-                self.tracker.finished_data_movement_mb - base_mb)
+                moved_mb)
+            span = _obsvc_tracer().current()
+            if span is not None:
+                span.set("completed", counts[ExecutionTaskState.COMPLETED])
+                span.set("dead", counts[ExecutionTaskState.DEAD])
+                span.set("aborted", counts[ExecutionTaskState.ABORTED])
+                span.set("moved_mb", round(moved_mb, 1))
+            # Stage 3 of the self-healing audit: attach this batch's outcome
+            # to the entry whose fix started it (no-op for user-triggered
+            # executions with no pending entry).
+            audit_log().attach_execution_outcome(
+                completed=counts[ExecutionTaskState.COMPLETED],
+                dead=counts[ExecutionTaskState.DEAD],
+                aborted=counts[ExecutionTaskState.ABORTED],
+                moved_mb=moved_mb)
             for fn in self._on_finish:
                 try:
                     fn()

@@ -55,6 +55,8 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+from cruise_control_tpu.obsvc.audit import audit_log
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
 LOG = logging.getLogger(__name__)
 
@@ -210,7 +212,10 @@ class CruiseControl:
                         self.default_completeness
                         or ModelCompletenessRequirements()):
                     continue
-                self.proposals()
+                # Root span: the daemon thread has no request context, so
+                # each precompute tick is its own trace in the ring.
+                with _obsvc_tracer().span("precompute", generation=generation):
+                    self.proposals()
                 self._precomputed_generation = generation
             except Exception as e:          # noqa: BLE001 — keep the daemon up
                 LOG.warning("proposal precompute failed: %s", e)
@@ -347,6 +352,26 @@ class CruiseControl:
     # ------------------------------------------------------------ operations
 
     def _run_operation(
+        self,
+        goals: Optional[Sequence[str]],
+        options: OptimizationOptions,
+        dryrun: bool,
+        model_mutator=None,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        use_cached: bool = False,
+    ) -> OperationResult:
+        tr = _obsvc_tracer()
+        if not tr.enabled:
+            return self._run_operation_impl(goals, options, dryrun,
+                                            model_mutator, requirements,
+                                            use_cached)
+        with tr.span("operation", dryrun=dryrun,
+                     num_goals=len(goals or self.default_goals)):
+            return self._run_operation_impl(goals, options, dryrun,
+                                            model_mutator, requirements,
+                                            use_cached)
+
+    def _run_operation_impl(
         self,
         goals: Optional[Sequence[str]],
         options: OptimizationOptions,
@@ -524,27 +549,39 @@ class CruiseControl:
 
     def _fix_anomaly(self, anomaly: Anomaly) -> bool:
         """Self-healing dispatch (§3.5): every fix is a normal operation."""
+        # Stage 2 of the self-healing audit: annotate the detector's entry
+        # with the concrete operation chosen for this anomaly.
+        def note(action: str) -> None:
+            audit_log().set_action(anomaly.anomaly_type.name, action)
+
         try:
             if isinstance(anomaly, BrokerFailures):
+                note("remove_broker")
                 r = self.remove_brokers(sorted(anomaly.failed_brokers), dryrun=False)
             elif isinstance(anomaly, DiskFailures):
+                note("fix_offline_replicas")
                 r = self.fix_offline_replicas(dryrun=False)
             elif isinstance(anomaly, GoalViolations):
+                note("rebalance")
                 r = self.rebalance(anomaly.fixable_violated_goals or None,
                                    dryrun=False)
             elif isinstance(anomaly, MetricAnomaly):
                 if anomaly.suggested_action == "remove":
+                    note("remove_broker")
                     r = self.remove_brokers([anomaly.broker_id], dryrun=False)
                 elif anomaly.suggested_action == "demote":
+                    note("demote_broker")
                     r = self.demote_brokers([anomaly.broker_id], dryrun=False)
                 else:
                     return False
             elif isinstance(anomaly, TopicAnomaly):
                 if anomaly.target_replication_factor is None:
                     return False
+                note("topic_replication_factor")
                 r = self.change_topic_replication_factor(
                     anomaly.topic, anomaly.target_replication_factor, dryrun=False)
             elif isinstance(anomaly, MaintenanceEvent):
+                note(f"maintenance:{anomaly.plan}")
                 r = self._run_maintenance(anomaly)
             else:
                 return False

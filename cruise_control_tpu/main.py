@@ -64,6 +64,10 @@ def build_app(config: CruiseControlConfig,
     compile_svc = configure_compile(config)
     compile_svc.cache.activate(
         goal_stack_hash=goal_stack_hash(config.goal_names("default.goals")))
+    # Observability next: trace.* keys gate the span tracer / audit-log
+    # bounds / profile dir before any request or daemon can create spans.
+    from cruise_control_tpu.obsvc import configure as configure_obsvc
+    configure_obsvc(config)
     backend = demo_metadata()
     metadata_client = MetadataClient(backend,
                                      ttl_ms=config["metadata.max.age.ms"])

@@ -1,0 +1,36 @@
+"""Observability service: span tracing, profiler capture, self-healing audit.
+
+Public surface:
+
+* :func:`tracer` — the process :class:`~cruise_control_tpu.obsvc.tracer.Tracer`
+  singleton (disabled by default; ``span()`` is a shared no-op until
+  ``trace.enabled=true``).
+* :func:`audit_log` — the bounded self-healing audit log (always on; a
+  deque append per anomaly decision).
+* :mod:`~cruise_control_tpu.obsvc.profiler` — ``POST /profile`` captures.
+* :func:`configure` — apply ``trace.*`` config keys at service build time.
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.obsvc.audit import AuditLog, audit_log
+from cruise_control_tpu.obsvc.tracer import Span, Tracer, tracer
+
+__all__ = ["AuditLog", "Span", "Tracer", "audit_log", "configure",
+           "tracer"]
+
+
+def configure(config) -> Tracer:
+    """Wire ``trace.*`` keys into the obsvc singletons.
+
+    Called from ``main.build_app`` right after the compile service is
+    configured; safe to call repeatedly (tests rebuild apps in-process).
+    """
+    from cruise_control_tpu.obsvc import profiler
+
+    tr = tracer()
+    tr.configure(enabled=bool(config.get("trace.enabled")),
+                 ring_size=int(config.get("trace.ring.size")))
+    audit_log().configure(maxlen=int(config.get("trace.audit.log.size")))
+    profiler.configure(str(config.get("trace.profile.dir") or ""))
+    return tr

@@ -1,0 +1,63 @@
+"""``POST /profile`` backing: one-shot ``jax.profiler`` capture windows.
+
+Wraps ``jax.profiler.start_trace``/``stop_trace`` with a non-reentrant
+lock (the XLA profiler is a process singleton — overlapping captures
+abort) and writes a TensorBoard-loadable trace directory per capture:
+``<trace.profile.dir>/profile-<epoch_ms>``.  View with
+``tensorboard --logdir <dir>`` → Profile plugin, or feed the contained
+``*.trace.json.gz`` to Perfetto.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+MAX_DURATION_S = 600.0
+
+_LOCK = threading.Lock()
+_DEFAULT_DIR: Optional[str] = None
+
+
+class ProfileInProgress(RuntimeError):
+    """A capture window is already open (the XLA profiler is a singleton)."""
+
+
+def configure(profile_dir: str) -> None:
+    global _DEFAULT_DIR
+    _DEFAULT_DIR = profile_dir or None
+
+
+def default_dir() -> str:
+    if _DEFAULT_DIR:
+        return _DEFAULT_DIR
+    return os.path.join(tempfile.gettempdir(), "cruise_control_tpu_profiles")
+
+
+def capture(duration_s: float,
+            out_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Block for ``duration_s`` while the JAX profiler records all device
+    + host activity, then return the trace directory."""
+    if not (0.0 < duration_s <= MAX_DURATION_S):
+        raise ValueError(
+            f"duration_s must be in (0, {MAX_DURATION_S:g}], "
+            f"got {duration_s!r}")
+    if not _LOCK.acquire(blocking=False):
+        raise ProfileInProgress("a profile capture is already running")
+    try:
+        import jax
+
+        trace_dir = os.path.join(out_dir or default_dir(),
+                                 f"profile-{int(time.time() * 1000)}")
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            time.sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+        return {"trace_dir": trace_dir, "duration_s": duration_s}
+    finally:
+        _LOCK.release()

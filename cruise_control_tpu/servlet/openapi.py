@@ -57,6 +57,14 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
     "compile_cache": ("Compile-service state: shape-bucket policy, compiled "
                       "lane widths, persistent XLA cache, warmup progress, "
                       "per-bucket compile/hit/miss counters", [], "VIEWER"),
+    "trace": ("Recent root span trees (per-request / precompute / executor "
+              "batch) and the per-phase time rollup; empty unless "
+              "trace.enabled", [], "VIEWER"),
+    "profile": ("Capture a JAX device+host profile for duration_s seconds "
+                "and write a TensorBoard trace directory", [
+        ("duration_s", "number", "capture window seconds (default 2, "
+         "max 600)"),
+    ], "ADMIN"),
     "rebalance": ("Full-cluster rebalance", [
         ("dryrun", "boolean", "propose only (default true)"),
         ("goals", "string", "comma list of goal names"),

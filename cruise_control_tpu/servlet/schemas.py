@@ -264,6 +264,52 @@ COMPILE_CACHE_SCHEMA = {
     },
 }
 
+_SPAN_SCHEMA = {
+    "type": "object",
+    "required": ["span_id", "name", "start_ms"],
+    "properties": {
+        "span_id": {"type": "integer"},
+        "parent_id": {"type": ["integer", "null"]},
+        "name": {"type": "string"},
+        "start_ms": {"type": "number"},
+        # null while the span (or a late-finishing child) is in progress.
+        "wall_ms": {"type": ["number", "null"]},
+        "attrs": {"type": "object"},
+        "children": {"type": "array", "items": {"type": "object"}},
+    },
+}
+
+TRACE_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "traces", "rollup"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "traces": {"type": "array", "items": _SPAN_SCHEMA},
+        "rollup": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "object",
+                "required": ["count", "total_ms", "mean_ms"],
+                "properties": {
+                    "count": {"type": "integer"},
+                    "total_ms": {"type": "number"},
+                    "mean_ms": {"type": "number"},
+                },
+            },
+        },
+    },
+}
+
+PROFILE_SCHEMA = {
+    "type": "object",
+    "required": ["message", "trace_dir", "duration_s"],
+    "properties": {
+        "message": {"type": "string"},
+        "trace_dir": {"type": "string"},
+        "duration_s": {"type": "number"},
+    },
+}
+
 ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "state": STATE_SCHEMA,
     "load": LOAD_SCHEMA,
@@ -287,4 +333,6 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "admin": ADMIN_SCHEMA,
     "metrics": METRICS_JSON_SCHEMA,
     "compile_cache": COMPILE_CACHE_SCHEMA,
+    "trace": TRACE_SCHEMA,
+    "profile": PROFILE_SCHEMA,
 }

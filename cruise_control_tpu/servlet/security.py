@@ -42,10 +42,11 @@ class Role(Enum):
 
 _RANK = {Role.VIEWER: 0, Role.USER: 1, Role.ADMIN: 2}
 
-# DefaultRoleSecurityProvider.java:50-62.  compile_cache rides the VIEWER
-# tier like metrics: it is pure observability (no cluster data beyond shapes).
+# DefaultRoleSecurityProvider.java:50-62.  compile_cache and trace ride the
+# VIEWER tier like metrics: pure observability (no cluster data beyond
+# shapes and phase timings).
 _VIEWER_GET = {"kafka_cluster_state", "user_tasks", "review_board", "metrics",
-               "compile_cache"}
+               "compile_cache", "trace"}
 _ADMIN_GET = {"bootstrap", "train"}
 
 

@@ -20,10 +20,12 @@ under/over/right-sized gauges.
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import json
 import logging
 import threading
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -35,20 +37,22 @@ from cruise_control_tpu.common.exceptions import (
 )
 from cruise_control_tpu.detector.anomalies import AnomalyType
 from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.servlet.purgatory import Purgatory
 from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
 
 LOG = logging.getLogger(__name__)
 
 USER_TASK_HEADER = "User-Task-ID"
+REQUEST_ID_HEADER = "X-Request-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "compile_cache"}
+                 "metrics", "compile_cache", "trace"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
-                  "topic_configuration"}
+                  "topic_configuration", "profile"}
 # POSTs subject to two-step verification (mutating cluster state).
 REVIEWABLE = {"add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
               "demote_broker", "topic_configuration"}
@@ -247,6 +251,12 @@ class CruiseControlApp:
             return 200, {"sensors": registry().snapshot()}, {}
         return 200, registry().prometheus_text(), {}
 
+    def _ep_trace(self, params, task_id):
+        """Recent root span trees + per-phase rollup (obsvc tracer)."""
+        tr = _obsvc_tracer()
+        return 200, {"enabled": tr.enabled, "traces": tr.traces(),
+                     "rollup": tr.rollup()}, {}
+
     def _ep_compile_cache(self, params, task_id):
         """Compile-service admin view: bucket policy, compiled lane widths,
         persistent-cache state, warmup progress, per-bucket hit/miss/compile
@@ -316,13 +326,36 @@ class CruiseControlApp:
         return 200, {"message": "training done",
                      "coefficients": None if coef is None else coef.tolist()}, {}
 
+    def _ep_profile(self, params, task_id):
+        """Admin: capture a JAX profiler trace for ``duration_s`` seconds
+        (synchronous — the handler thread sleeps through the window)."""
+        from cruise_control_tpu.obsvc import profiler
+        try:
+            duration_s = float(params.get("duration_s", "2.0"))
+        except ValueError:
+            return 400, {"error": "duration_s must be a number"}, {}
+        try:
+            out = profiler.capture(duration_s)
+        except ValueError as e:
+            return 400, {"error": str(e)}, {}
+        except profiler.ProfileInProgress as e:
+            return 409, {"error": str(e)}, {}
+        except Exception as e:   # noqa: BLE001 — profiler backend seam
+            LOG.exception("profile capture failed")
+            return 500, {"error": type(e).__name__, "message": str(e)}, {}
+        return 200, {"message": "profile captured", **out}, {}
+
     # ---- async operations (202-until-done)
 
     def _async(self, endpoint: str, params: Dict[str, str], task_id: Optional[str],
                op: Callable) -> Tuple[int, Dict, Dict[str, str]]:
         query = urllib.parse.urlencode(params)
+        # Snapshot this request's context (most importantly the active trace
+        # span) so the user-task worker thread parents its spans under the
+        # request's root instead of starting orphan traces.
+        ctx = contextvars.copy_context()
         task = self.user_tasks.get_or_create(task_id, endpoint, query,
-                                             lambda progress: op())
+                                             lambda progress: ctx.run(op))
         headers = {USER_TASK_HEADER: task.task_id}
         if task.state is TaskState.ACTIVE:
             try:
@@ -496,21 +529,28 @@ def _make_handler(app: CruiseControlApp):
                 if "application/x-www-form-urlencoded" in ctype:
                     params.update(_parse_params(body.decode()))
             task_id = self.headers.get(USER_TASK_HEADER)
-            try:
-                status, payload, headers = app.handle(method, endpoint, params,
-                                                      task_id)
-            except OngoingExecutionError as e:
-                status, payload, headers = 409, {"error": str(e)}, {}
-            except CruiseControlError as e:
-                status, payload, headers = 500, {
-                    "error": type(e).__name__, "message": str(e)}, {}
-            except Exception as e:       # noqa: BLE001 — never kill the server
-                LOG.exception("request failed")
-                status, payload, headers = 500, {
-                    "error": type(e).__name__, "message": str(e)}, {}
+            # Request id in/out: honor a caller-supplied X-Request-ID (so
+            # operators can correlate across proxies), mint one otherwise;
+            # the root span carries it into /trace.
+            request_id = self.headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex[:16]
+            with _obsvc_tracer().span(f"http.{endpoint}", method=method,
+                                      request_id=request_id):
+                try:
+                    status, payload, headers = app.handle(method, endpoint,
+                                                          params, task_id)
+                except OngoingExecutionError as e:
+                    status, payload, headers = 409, {"error": str(e)}, {}
+                except CruiseControlError as e:
+                    status, payload, headers = 500, {
+                        "error": type(e).__name__, "message": str(e)}, {}
+                except Exception as e:   # noqa: BLE001 — never kill the server
+                    LOG.exception("request failed")
+                    status, payload, headers = 500, {
+                        "error": type(e).__name__, "message": str(e)}, {}
             if isinstance(payload, dict):
                 payload.setdefault("version", 1)
-            headers = {**(headers or {}), **self._mutual_auth_headers()}
+            headers = {**(headers or {}), REQUEST_ID_HEADER: request_id,
+                       **self._mutual_auth_headers()}
             self._send(status, payload, headers)
 
         def _authenticate_or_401(self):

@@ -1,0 +1,144 @@
+"""Sensor-doc drift guard: boot the service, scrape /metrics, diff the doc.
+
+docs/SENSORS.md is machine-parsable — one backticked sensor name (or fnmatch
+glob for fan-out families) in the first column of each table row.  This
+script boots the demo service, drives the endpoints that lazily register
+sensors (state, proposals to completion), scrapes ``/metrics?json=true``,
+and fails if either side drifted:
+
+- a documented exact name absent from the live scrape, or a documented glob
+  matching nothing, means the doc promises a sensor the service no longer
+  exports;
+- a live sensor matched by no documented row means a sensor was added
+  without documenting it.
+
+Run standalone (``python scripts/check_sensors.py``) or via the tier-1
+suite — tests/test_sensors.py imports ``parse_sensors_md`` / ``diff`` /
+``collect_live`` from here and asserts no drift.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SENSORS_MD = os.path.join(REPO, "docs", "SENSORS.md")
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+def parse_sensors_md(path: str = SENSORS_MD):
+    """Documented sensor patterns: the first backticked token in the first
+    column of every table body row (header/separator rows have none)."""
+    patterns = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("|"):
+                continue
+            first_cell = line.split("|")[1]
+            m = _BACKTICK.search(first_cell)
+            if m:
+                patterns.append(m.group(1))
+    return patterns
+
+
+def diff(documented, live):
+    """``(missing, undocumented)`` — documented patterns matching no live
+    sensor, and live sensors matched by no documented pattern."""
+    live = sorted(live)
+    missing = [p for p in documented if not fnmatch.filter(live, p)]
+    undocumented = [n for n in live
+                    if not any(fnmatch.fnmatch(n, p) for p in documented)]
+    return missing, undocumented
+
+
+def collect_live(timeout_s: float = 90.0):
+    """Boot the demo service (tracing ON so ``Trace.*`` timers exist), wait
+    for a valid window, run /proposals to completion (first optimization
+    registers the GoalOptimizer / provision / CompileService sensors), and
+    return the JSON sensor snapshot plus the Prometheus text body."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig)
+    from cruise_control_tpu.main import build_app
+
+    cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
+                               "partition.metrics.window.ms": 600,
+                               "trace.enabled": True})
+    app = build_app(cfg, port=0)
+    app.cc.start_up()
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
+
+        def get(path, headers=None):
+            req = urllib.request.Request(base + path, headers=headers or {})
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read().decode(), dict(r.headers)
+
+        get("/state")
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            _, body, _ = get("/metrics?json=true")
+            snap = json.loads(body)["sensors"]
+            if snap.get("LoadMonitor.valid-windows", {}).get("value", 0) > 0:
+                break
+            time.sleep(0.5)
+        # One goal keeps the compile bill small; run it twice — the cold
+        # pass registers the GoalOptimizer/provision/compile-count sensors,
+        # the warm pass the cache-HIT counters (ignore_cache so the second
+        # request re-optimizes instead of returning the cached proposal).
+        for attempt in ("", "&ignore_cache=true"):
+            qs = "?goals=ReplicaDistributionGoal" + attempt
+            status, _, headers = get("/proposals" + qs)
+            task_id = headers.get("User-Task-ID")
+            while status == 202 and time.time() < deadline:
+                time.sleep(0.5)
+                status, _, headers = get("/proposals" + qs,
+                                         headers={"User-Task-ID": task_id})
+            if status != 200:
+                raise RuntimeError(f"/proposals did not complete: {status}")
+        _, body, _ = get("/metrics?json=true")
+        _, text, _ = get("/metrics")
+        return json.loads(body)["sensors"], text
+    finally:
+        app.stop()
+        app.cc.shutdown()
+        # Hermeticity for in-suite callers: build_app enabled the process
+        # tracer; later test modules expect the default-off state.
+        from cruise_control_tpu.obsvc.tracer import tracer
+        tracer().configure(enabled=False, ring_size=32)
+        tracer().reset()
+
+
+def main() -> int:
+    documented = parse_sensors_md()
+    if not documented:
+        print(f"no sensor rows parsed from {SENSORS_MD}", file=sys.stderr)
+        return 1
+    snap, _ = collect_live()
+    missing, undocumented = diff(documented, set(snap))
+    for p in missing:
+        print(f"DOCUMENTED BUT NOT EXPORTED: {p}", file=sys.stderr)
+    for n in undocumented:
+        print(f"EXPORTED BUT NOT DOCUMENTED: {n}", file=sys.stderr)
+    if missing or undocumented:
+        print(f"\nsensor drift: {len(missing)} missing, "
+              f"{len(undocumented)} undocumented — update docs/SENSORS.md",
+              file=sys.stderr)
+        return 1
+    print(f"OK: {len(live)} live sensors covered by "
+          f"{len(documented)} documented rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    raise SystemExit(main())

@@ -1,0 +1,278 @@
+"""obsvc tests: span tracer, self-healing audit log, /trace + /profile
+end-to-end (tentpole of the observability PR — the reference has only flat
+Dropwizard sensors; the span tree is this port's addition)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.obsvc.audit import AuditLog
+from cruise_control_tpu.obsvc.tracer import Tracer, tracer
+
+USER_TASK_HEADER = "User-Task-ID"
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_tracer_disabled_is_noop():
+    tr = Tracer(enabled=False)
+    ctx = tr.span("anything", x=1)
+    ctx2 = tr.span("other")
+    assert ctx is ctx2                      # shared no-op context manager
+    with ctx as span:
+        span.set("k", "v")                  # swallowed
+        span.add_ms("ms", 5.0)
+        assert tr.current() is None
+    assert tr.traces() == []
+    assert tr.rollup() == {}
+
+
+def test_tracer_nesting_attrs_and_ring_bound():
+    tr = Tracer(enabled=True, ring_size=2)
+    for i in range(3):
+        with tr.span(f"root{i}", idx=i) as root:
+            assert tr.current() is root
+            with tr.span("child") as child:
+                child.set("moves", 7)
+            assert tr.current() is root
+    roots = tr.traces()
+    assert [r["name"] for r in roots] == ["root1", "root2"]   # oldest evicted
+    assert roots[-1]["attrs"]["idx"] == 2
+    (child,) = roots[-1]["children"]
+    assert child["name"] == "child"
+    assert child["parent_id"] == roots[-1]["span_id"]
+    assert child["attrs"]["moves"] == 7
+    assert child["wall_ms"] is not None and roots[-1]["wall_ms"] is not None
+    roll = tr.rollup()
+    assert roll["child"]["count"] == 3
+    assert tr.rollup(reset=True)["child"]["total_ms"] >= 0.0
+    assert tr.rollup() == {}                # reset drained it
+
+
+def test_tracer_late_child_renders_in_progress():
+    """202 shape: the root (http request) closes while a child (user task)
+    still runs — /trace must render the child with wall_ms null, then pick
+    up the final number once it closes (tree mutates in place)."""
+    import contextvars
+
+    tr = Tracer(enabled=True)
+    root_ctx = tr.span("http.rebalance")
+    root_ctx.__enter__()
+    # What servlet._async does at submit time: the worker runs in a COPY of
+    # the request context, so its tokens never interleave with this one's.
+    ctx = contextvars.copy_context()
+    child_ctx = tr.span("operation")
+    ctx.run(child_ctx.__enter__)
+    root_ctx.__exit__(None, None, None)     # request returned 202
+    (snap,) = tr.traces()
+    assert snap["children"][0]["wall_ms"] is None
+    ctx.run(child_ctx.__exit__, None, None, None)
+    (snap,) = tr.traces()
+    assert snap["children"][0]["wall_ms"] is not None
+
+
+def test_span_error_attr_and_execute_split():
+    tr = Tracer(enabled=True)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    assert tr.traces()[-1]["attrs"]["error"] == "RuntimeError"
+    with tr.span("goal.X") as span:
+        span.set("compile_ms", 1.0)
+    d = tr.traces()[-1]
+    assert d["attrs"]["execute_ms"] == round(max(d["wall_ms"] - 1.0, 0.0), 3)
+
+
+def test_tracer_mirrors_rollup_into_registry_timer():
+    from cruise_control_tpu.common.metrics import registry
+    tr = Tracer(enabled=True)
+    before = registry().timer("Trace.phase-mirror").stats()["count"]
+    with tr.span("phase-mirror"):
+        pass
+    assert registry().timer("Trace.phase-mirror").stats()["count"] == before + 1
+
+
+# ---------------------------------------------------------------- audit log
+
+
+def test_audit_chain_and_bound():
+    log = AuditLog(maxlen=4)
+    eid = log.record("GOAL_VIOLATION", "3 goals violated", "FIX")
+    log.set_action("GOAL_VIOLATION", "rebalance")
+    log.set_outcome(eid, "FIX_STARTED")
+    log.attach_execution_outcome(completed=5, dead=1, aborted=0, moved_mb=42.0)
+    (entry,) = log.entries()
+    assert entry["decision"] == "FIX" and entry["action"] == "rebalance"
+    assert entry["outcome"] == "FIX_STARTED"
+    assert entry["executionOutcome"]["completed"] == 5
+    assert entry["executionOutcome"]["movedMB"] == 42.0
+    # User-triggered executions (no FIX_STARTED entry pending) are dropped.
+    log.attach_execution_outcome(completed=9, dead=0, aborted=0, moved_mb=1.0)
+    assert log.entries()[0]["executionOutcome"]["completed"] == 5
+    for _ in range(6):
+        log.record("BROKER_FAILURE", "b", "IGNORED")
+    assert len(log.entries()) == 4          # bounded
+
+
+def test_audit_set_action_targets_newest_open_entry():
+    log = AuditLog()
+    log.record("BROKER_FAILURE", "old", "FIX")
+    log.set_action("BROKER_FAILURE", "remove_broker")
+    log.record("BROKER_FAILURE", "new", "FIX")
+    log.set_action("BROKER_FAILURE", "fix_offline_replicas")
+    first, second = log.entries()
+    assert first["action"] == "remove_broker"
+    assert second["action"] == "fix_offline_replicas"
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+def _post(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {},
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def _find(span, prefix):
+    """All descendant spans (incl. self) whose name starts with prefix."""
+    hits = [span] if span["name"].startswith(prefix) else []
+    for c in span.get("children", ()):
+        hits.extend(_find(c, prefix))
+    return hits
+
+
+GOALS = ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
+
+
+def test_trace_and_profile_endpoints_end_to_end(tmp_path):
+    """Acceptance: after one /rebalance?dryrun=true the /trace tree has a
+    root covering the request with >= one goal span per configured goal,
+    each with wall-ms and a compile/execute split; /profile writes a
+    TensorBoard trace dir; X-Request-ID is echoed."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig)
+    from cruise_control_tpu.main import build_app
+
+    cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
+                               "partition.metrics.window.ms": 600,
+                               "trace.enabled": True,
+                               # Every poll closes an http.* root; the ring
+                               # must outlive the polling loops below.
+                               "trace.ring.size": 256,
+                               "trace.profile.dir": str(tmp_path)})
+    app = build_app(cfg, port=0)
+    tracer().reset()
+    app.cc.start_up()
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, body, _ = _get(base, "/metrics?json=true")
+            snap = json.loads(body)["sensors"]
+            if snap.get("LoadMonitor.valid-windows", {}).get("value", 0) > 0:
+                break
+            time.sleep(0.5)
+
+        # Request-id: echoed when supplied, minted when absent.
+        _, _, headers = _get(base, "/state",
+                             headers={"X-Request-ID": "req-abc"})
+        assert headers.get("X-Request-ID") == "req-abc"
+        _, _, headers = _get(base, "/state")
+        assert headers.get("X-Request-ID")
+
+        goals = ",".join(GOALS)
+        status, body, headers = _post(
+            base, f"/rebalance?dryrun=true&goals={goals}")
+        task_id = headers.get(USER_TASK_HEADER)
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.5)
+            status, body, headers = _post(
+                base, f"/rebalance?dryrun=true&goals={goals}",
+                headers={USER_TASK_HEADER: task_id})
+        assert status == 200, body
+
+        _, body, _ = _get(base, "/trace")
+        trace = json.loads(body)
+        assert trace["enabled"] is True
+        # The 202-async operation's spans land UNDER the ORIGINATING http
+        # span (contextvars copied into the user-task thread); later polls
+        # of the same task are thin http.rebalance roots with no children.
+        roots = [t for t in trace["traces"]
+                 if t["name"] == "http.rebalance" and _find(t, "operation")]
+        assert roots, [t["name"] for t in trace["traces"]]
+        root = roots[-1]
+        for goal in GOALS:
+            gspans = _find(root, f"goal.{goal}")
+            assert gspans, f"no goal span for {goal}"
+            for gspan in gspans:
+                assert gspan["wall_ms"] is not None
+                assert "compile_ms" in gspan["attrs"]
+                assert "execute_ms" in gspan["attrs"]
+                assert "fresh_compiles" in gspan["attrs"]
+        assert _find(root, "optimize")
+        assert trace["rollup"]["http.rebalance"]["count"] >= 1
+
+        status, body, _ = _post(base, "/profile?duration_s=0.2")
+        assert status == 200, body
+        out = json.loads(body)
+        assert os.path.isdir(out["trace_dir"])
+        assert out["trace_dir"].startswith(str(tmp_path))
+
+        status, body, _ = _post(base, "/profile?duration_s=nope")
+        assert status == 400
+        status, body, _ = _post(base, "/profile?duration_s=-1")
+        assert status == 400
+    finally:
+        app.stop()
+        app.cc.shutdown()
+        tracer().configure(enabled=False, ring_size=32)
+        tracer().reset()
+
+
+def test_trace_disabled_path_adds_no_spans():
+    """With trace.enabled=false (default) the proposal path must not
+    produce spans — the acceptance bar for zero-overhead-when-off."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.testing import deterministic as det
+
+    tr = tracer()
+    tr.configure(enabled=False, ring_size=32)
+    tr.reset()
+    state, placement, meta = det.unbalanced().freeze(pad_replicas_to=64,
+                                                     pad_brokers_to=8)
+    GoalOptimizer(goal_names=GOALS).optimizations(state, placement, meta)
+    assert tr.traces() == []
+    assert tr.rollup() == {}
+
+
+def test_state_exposes_self_healing_audit():
+    from cruise_control_tpu.obsvc.audit import audit_log
+    from tests.test_facade import build_stack
+
+    cc, _backend, _cluster = build_stack(num_brokers=4, partitions=8)
+    audit_log().clear()
+    audit_log().record("GOAL_VIOLATION", "test entry", "FIX")
+    try:
+        detector_state = cc.state()["AnomalyDetectorState"]
+        audit = detector_state["selfHealingAudit"]
+        assert any(e["anomalyType"] == "GOAL_VIOLATION" for e in audit)
+    finally:
+        audit_log().clear()
+        cc.shutdown()

@@ -2,11 +2,25 @@
 Dropwizard sensor surface across Executor / LoadMonitor / UserTaskManager /
 AnomalyDetector / GoalOptimizer / MetricFetcherManager / Servlet)."""
 
-import json
-import time
-import urllib.request
+import importlib.util
+import os
+import re
 
-from cruise_control_tpu.common.metrics import MetricRegistry, registry
+import pytest
+
+from cruise_control_tpu.common.metrics import (SCRAPE_ERRORS_SENSOR,
+                                               MetricRegistry, registry)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_sensors_module():
+    """scripts/ is not a package; load the drift guard by path."""
+    spec = importlib.util.spec_from_file_location(
+        "check_sensors", os.path.join(_REPO, "scripts", "check_sensors.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_registry_instruments():
@@ -39,47 +53,144 @@ def test_registry_bad_gauge_is_isolated():
     assert snap["good"]["value"] == 1
 
 
-def test_service_sensor_surface():
+def test_counter_rate_uses_observed_lifetime():
+    """A counter younger than the 60 s window divides by its lifetime
+    (floored at 1 s), not the full window — 4 events in the first second
+    must read ~4/s, not 4/60 (the fresh-boot under-reporting bug)."""
+    c = MetricRegistry().counter("young")
+    for _ in range(4):
+        c.inc()
+    # Wall-clock tolerant: even a very slow run keeps lifetime << 60 s.
+    assert c.rate() > 4 / 30.0
+    assert c.rate() <= 4.0 + 1e-9          # floor keeps bursts bounded
+
+
+def test_scrape_errors_counter_always_materialized():
+    """Raising gauge callbacks are not silent: snapshot() bumps the
+    scrape-errors counter IN THE SAME scrape, and a clean registry still
+    exports the sensor at 0 so dashboards can alert on it existing."""
+    clean = MetricRegistry()
+    snap = clean.snapshot()
+    assert snap[SCRAPE_ERRORS_SENSOR]["count"] == 0
+    reg = MetricRegistry()
+    reg.gauge("bad", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap[SCRAPE_ERRORS_SENSOR]["count"] == 1
+    snap = reg.snapshot()
+    assert snap[SCRAPE_ERRORS_SENSOR]["count"] == 2   # bumps per scrape
+
+
+def test_prometheus_name_collisions_rejected_at_registration():
+    """Two sensors that sanitize to one Prometheus series would silently
+    shadow each other in /metrics text; same name re-registered as another
+    kind would emit duplicate TYPE lines — both fail loudly instead."""
+    reg = MetricRegistry()
+    reg.counter("a.b-c")
+    with pytest.raises(ValueError, match="collides"):
+        reg.counter("a.b_c")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.timer("a.b-c")
+    # Same name, same kind is the normal get-or-create path.
+    assert reg.counter("a.b-c") is reg.counter("a.b-c")
+
+
+@pytest.fixture(scope="module")
+def service_scrape():
+    """ONE booted-and-driven service scrape shared by the surface,
+    exposition-validity, and doc-drift tests (a boot + proposals run is the
+    expensive part; three separate boots would triple it).  Returns the
+    check_sensors module plus its (json snapshot, prometheus text)."""
+    mod = _check_sensors_module()
+    snap, text = mod.collect_live()
+    return mod, snap, text
+
+
+def test_service_sensor_surface(service_scrape):
     """Boot the demo service, hit /metrics, and check the reference's sensor
     families are present with live values."""
-    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
-    from cruise_control_tpu.main import build_app
+    _, snap, text = service_scrape
+    names = set(snap)
+    for expected in (
+        "Executor.replica-action-in-progress",
+        "Executor.leadership-movements-global-cap",
+        "LoadMonitor.valid-windows",
+        "LoadMonitor.monitored-partitions-percentage",
+        "LoadMonitor.cluster-model-creation-timer",
+        "UserTaskManager.num-active-user-tasks",
+        "MetricFetcherManager.partition-samples-fetcher-timer",
+        "KafkaCruiseControlServlet.state-request-rate",
+        "KafkaCruiseControlServlet.state-successful-request-execution-timer",
+    ):
+        assert expected in names, expected
+    assert snap["LoadMonitor.valid-windows"]["value"] > 0
+    # Prometheus text endpoint renders.
+    assert "kafka_cruisecontrol_LoadMonitor_valid_windows" in text
 
-    cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
-                               "partition.metrics.window.ms": 600})
-    app = build_app(cfg, port=0)
-    app.cc.start_up()
-    app.start()
-    try:
-        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
-        # Drive one state request so servlet sensors exist, wait for sampling.
-        urllib.request.urlopen(base + "/state")
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            snap = json.load(urllib.request.urlopen(base + "/metrics?json=true"))["sensors"]
-            if snap.get("LoadMonitor.valid-windows", {}).get("value", 0) > 0:
-                break
-            time.sleep(0.5)
-        names = set(snap)
-        for expected in (
-            "Executor.replica-action-in-progress",
-            "Executor.leadership-movements-global-cap",
-            "LoadMonitor.valid-windows",
-            "LoadMonitor.monitored-partitions-percentage",
-            "LoadMonitor.cluster-model-creation-timer",
-            "UserTaskManager.num-active-user-tasks",
-            "MetricFetcherManager.partition-samples-fetcher-timer",
-            "KafkaCruiseControlServlet.state-request-rate",
-            "KafkaCruiseControlServlet.state-successful-request-execution-timer",
-        ):
-            assert expected in names, expected
-        assert snap["LoadMonitor.valid-windows"]["value"] > 0
-        # Prometheus text endpoint renders.
-        text = urllib.request.urlopen(base + "/metrics").read().decode()
-        assert "kafka_cruisecontrol_LoadMonitor_valid_windows" in text
-    finally:
-        app.stop()
-        app.cc.shutdown()
+
+_SERIES_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _assert_exposition_valid(text):
+    """Strict line-format check of the Prometheus text exposition: every
+    line is a well-formed TYPE declaration or a sample; TYPE precedes its
+    family's samples; no duplicate TYPE or sample series; every value
+    parses as a float."""
+    typed = {}
+    samples = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        assert line and line == line.strip(), f"line {lineno}: blank/padded"
+        if line.startswith("#"):
+            parts = line.split(" ")
+            assert parts[:2] == ["#", "TYPE"] and len(parts) == 4, \
+                f"line {lineno}: malformed comment {line!r}"
+            _, _, name, mtype = parts
+            assert _SERIES_RE.match(name), f"line {lineno}: bad name {name!r}"
+            assert mtype in ("counter", "gauge", "summary"), line
+            assert name not in typed, f"line {lineno}: duplicate TYPE {name}"
+            typed[name] = mtype
+        else:
+            parts = line.split(" ")
+            assert len(parts) == 2, f"line {lineno}: {line!r}"
+            name, value = parts
+            assert _SERIES_RE.match(name), f"line {lineno}: bad name {name!r}"
+            try:
+                float(value)
+            except ValueError:
+                raise AssertionError(
+                    f"line {lineno}: non-numeric value {value!r}") from None
+            assert name not in samples, f"line {lineno}: duplicate {name}"
+            samples.add(name)
+            assert any(name == base or name.startswith(base + "_")
+                       for base in typed), \
+                f"line {lineno}: sample {name} precedes its TYPE line"
+    assert typed and samples
+
+
+def test_exposition_checker_catches_junk():
+    _assert_exposition_valid(MetricRegistry().prometheus_text())
+    for bad in ("# TYPE x counter\nx 1\nx 2\n",          # duplicate series
+                "x 1\n",                                  # sample before TYPE
+                "# TYPE x counter\nx one\n",              # non-float value
+                "# TYPE x counter\n# TYPE x gauge\nx 1\n"):   # dup TYPE
+        with pytest.raises(AssertionError):
+            _assert_exposition_valid(bad)
+
+
+def test_metrics_exposition_valid(service_scrape):
+    """Strict line-format check of booted-service /metrics output."""
+    _, _, text = service_scrape
+    _assert_exposition_valid(text)
+
+
+def test_sensor_docs_current(service_scrape):
+    """Fail on drift between docs/SENSORS.md and the live sensor surface —
+    the tier-1 wiring of scripts/check_sensors.py."""
+    mod, snap, _ = service_scrape
+    documented = mod.parse_sensors_md()
+    assert documented, "docs/SENSORS.md parsed to zero sensor rows"
+    missing, undocumented = mod.diff(documented, set(snap))
+    assert not missing, f"documented but not exported: {missing}"
+    assert not undocumented, f"exported but not documented: {undocumented}"
 
 
 def test_optimizer_sensors():
