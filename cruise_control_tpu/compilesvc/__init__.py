@@ -12,7 +12,10 @@ compiled, and persist what must be compiled.
 """
 
 from cruise_control_tpu.compilesvc.buckets import ShapeBucketPolicy
-from cruise_control_tpu.compilesvc.cache import PersistentCompileCache
+from cruise_control_tpu.compilesvc.cache import (
+    PersistentCompileCache,
+    probe_cpu_cache_loader,
+)
 from cruise_control_tpu.compilesvc.chunking import LaneChunk, plan_lane_chunks
 from cruise_control_tpu.compilesvc.service import (
     CompileService,
@@ -33,6 +36,7 @@ __all__ = [
     "compile_service",
     "configure",
     "plan_lane_chunks",
+    "probe_cpu_cache_loader",
     "set_compile_service",
     "telemetry",
 ]

@@ -19,10 +19,14 @@ cache usable on CPU and TPU:
   unexpected failure deactivates the cache for this process instead of
   raising — a broken cache must never take down a solve.
 
-Default-off on CPU: the cross-process machine-feature skew above makes a
-shared CPU cache genuinely unsafe on this box, so CPU use is an explicit
-config opt-in (``compile.persistent.cache.enabled``); the TPU child keeps
-its env opt-in, now routed through this manager.
+CPU enablement is **feature-checked**, not blanket-off: the first CPU
+activation runs :func:`probe_cpu_cache_loader` — a two-subprocess
+write-then-load roundtrip through a scratch cache directory — and only
+proceeds when the loader demonstrably works on this host (result memoized
+per jaxlib+fingerprint, so the probe's two interpreter startups are paid
+once).  ``compile.persistent.cache.enabled`` stays the explicit opt-in;
+``CC_TPU_PERSIST_CACHE`` now also covers an unset-on-CPU default through
+``configure`` instead of applying only to the TPU bench child.
 """
 
 from __future__ import annotations
@@ -64,13 +68,89 @@ def default_root() -> str:
     return os.path.join(root, "cruise_control_tpu", "compile_cache")
 
 
+# The tiny program both probe children run: compile-or-load one jitted
+# reduction through the persistent cache at argv[1].  Child 1 populates the
+# entry; child 2 must LOAD it — if XLA:CPU's AOT loader trips on this host
+# (machine-feature skew, SIGILL), child 2 dies non-zero and the probe fails.
+_PROBE_SCRIPT = """
+import sys
+import jax
+import jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+out = jax.jit(lambda v: (v * 2.0).sum())(jnp.arange(16.0))
+assert float(out) == 240.0, float(out)
+"""
+
+
+def _default_probe_runner(workdir: str, timeout_s: float) -> bool:
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", _PROBE_SCRIPT, workdir],
+                           timeout=timeout_s, env=env,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        if r.returncode != 0:
+            return False
+    return True
+
+
+def probe_cpu_cache_loader(root: Optional[str] = None,
+                           timeout_s: float = 120.0,
+                           runner=None,
+                           refresh: bool = False) -> bool:
+    """Feature-check XLA:CPU's persistent-cache loader on THIS host.
+
+    Two child interpreters share one scratch cache dir: the first compiles
+    and persists a trivial executable, the second must load and run it.
+    The verdict is memoized under ``<root>/v<schema>/`` keyed by jaxlib +
+    machine fingerprint (the same axes the cache keys on), so a jaxlib
+    upgrade or host move re-probes.  ``runner`` is injectable for tests:
+    ``runner(workdir, timeout_s) -> bool``.  Never raises.
+    """
+    root = root or default_root()
+    key = f"cpu-probe-{jaxlib_version()}-{machine_fingerprint()}"
+    marker = os.path.join(root, f"v{SCHEMA_VERSION}", key + ".json")
+    try:
+        if not refresh and os.path.exists(marker):
+            with open(marker) as f:
+                return bool(json.load(f)["ok"])
+    except (OSError, ValueError, KeyError):
+        pass   # unreadable marker: re-probe
+    workdir = os.path.join(root, f"v{SCHEMA_VERSION}", key + ".work")
+    run = runner or _default_probe_runner
+    try:
+        os.makedirs(workdir, exist_ok=True)
+        ok = bool(run(workdir, timeout_s))
+    except Exception as e:   # noqa: BLE001 — a broken probe means "unsupported"
+        LOG.warning("CPU cache-loader probe failed to run (%s)", e)
+        ok = False
+    try:
+        os.makedirs(os.path.dirname(marker), exist_ok=True)
+        with open(marker, "w") as f:
+            json.dump({"ok": ok, "jaxlib": jaxlib_version(),
+                       "fingerprint": machine_fingerprint()}, f)
+    except OSError:
+        pass   # no marker: the probe just runs again next process
+    LOG.info("XLA:CPU persistent-cache loader probe: %s",
+             "supported" if ok else "unsupported")
+    return ok
+
+
 class PersistentCompileCache:
     def __init__(self, root: Optional[str] = None,
                  max_bytes: int = 4 << 30,
-                 enabled: bool = False):
+                 enabled: bool = False,
+                 cpu_probe: bool = True):
         self.root = root or default_root()
         self.max_bytes = int(max_bytes)
         self.enabled = bool(enabled)
+        # Gate CPU activations on probe_cpu_cache_loader (False = legacy
+        # blind-trust behavior, for operators who have validated the host).
+        self.cpu_probe = bool(cpu_probe)
         self.active_dir: Optional[str] = None
         self.last_warm: bool = False
 
@@ -103,6 +183,14 @@ class PersistentCompileCache:
             if platform_name is None:
                 import jax
                 platform_name = jax.default_backend()
+            if platform_name == "cpu" and self.cpu_probe \
+                    and not probe_cpu_cache_loader(self.root):
+                LOG.warning("XLA:CPU persistent-cache loader failed the "
+                            "feature probe on this host; leaving the "
+                            "persistent cache off")
+                self.active_dir = None
+                self.last_warm = False
+                return False
             path = self.cache_dir(platform_name, goal_stack_hash, bucket)
             os.makedirs(path, exist_ok=True)
             self._validate_or_quarantine(path)

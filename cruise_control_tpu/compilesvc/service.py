@@ -152,10 +152,26 @@ def configure(config) -> CompileService:
         growth=float(_get("compile.bucket.growth", 2.0)),
         max_lane_bucket=int(_get("compile.max.lane.bucket", 16)),
     )
+    # CC_TPU_PERSIST_CACHE historically applied only to the TPU bench child.
+    # With the feature-checked CPU loader probe the env opt-in can cover an
+    # UNSET config key on any backend: activation still runs the probe
+    # before touching jax.config on CPU, so "default-on" means "on where
+    # the loader demonstrably works".  An explicit config value wins.
+    import os
+    persist_env = os.environ.get("CC_TPU_PERSIST_CACHE", "")
+    explicit = hasattr(config, "originals") and \
+        "compile.persistent.cache.enabled" in getattr(config, "originals", {})
+    enabled = bool(_get("compile.persistent.cache.enabled", False))
+    root = str(_get("compile.persistent.cache.path", "")) or None
+    if persist_env and not explicit:
+        enabled = True
+        if persist_env.lower() not in ("1", "true", "yes") and root is None:
+            root = persist_env
     cache = PersistentCompileCache(
-        root=str(_get("compile.persistent.cache.path", "")) or None,
+        root=root,
         max_bytes=int(_get("compile.persistent.cache.max.bytes", 4 << 30)),
-        enabled=bool(_get("compile.persistent.cache.enabled", False)),
+        enabled=enabled,
+        cpu_probe=bool(_get("compile.persistent.cache.cpu.probe", True)),
     )
     svc = CompileService(
         policy=policy,

@@ -253,6 +253,11 @@ def _compile_def() -> ConfigDef:
              4 * 1024 * 1024 * 1024, range_validator(1),
              doc="per-entry-directory size cap; oldest executables evicted "
                  "first")
+    d.define("compile.persistent.cache.cpu.probe", ConfigType.BOOLEAN, True,
+             doc="gate CPU cache activation on a two-subprocess write-then-"
+                 "load probe of the XLA:CPU loader (memoized per jaxlib + "
+                 "machine fingerprint); false restores blind-trust "
+                 "activation for hosts validated out of band")
     return d
 
 
@@ -273,6 +278,31 @@ def _trace_def() -> ConfigDef:
     d.define("trace.profile.dir", ConfigType.STRING, "",
              doc="root directory for POST /profile TensorBoard trace dirs; "
                  "empty = <tmpdir>/cruise_control_tpu_profiles")
+    return d
+
+
+def _fuzz_def() -> ConfigDef:
+    """fuzzsvc keys (no reference analog — the reference's randomized
+    OptimizationVerifier corpora live in its JUnit parameters; here the
+    fuzz campaign is an operable service entrypoint)."""
+    d = ConfigDef()
+    d.define("fuzz.num.scenarios", ConfigType.INT, 8, range_validator(1),
+             doc="scenarios per campaign (seeds fuzz.seed.base..+N-1)")
+    d.define("fuzz.seed.base", ConfigType.INT, 100,
+             doc="first scenario seed; every failure replays from "
+                 "(seed, kind) alone")
+    d.define("fuzz.scenario.budget.s", ConfigType.DOUBLE, 120.0,
+             range_validator(0.001),
+             doc="per-scenario soft wall-clock budget; overruns are "
+                 "reported, not killed (a stuck solve IS a finding)")
+    d.define("fuzz.corpus.dir", ConfigType.STRING, ".fuzz-corpus",
+             doc="failing scenarios (and their shrunk .min forms) are "
+                 "saved here as replayable JSON")
+    d.define("fuzz.storm.cycles", ConfigType.INT, 1, range_validator(0),
+             doc="chaos-storm inject→detect→heal cycles per scenario; "
+                 "0 disables the storm")
+    d.define("fuzz.shrink.max.steps", ConfigType.INT, 8, range_validator(0),
+             doc="greedy-shrinker descent bound on a failing scenario")
     return d
 
 
@@ -337,7 +367,7 @@ class CruiseControlConfig:
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
                            .merge(_compile_def()).merge(_trace_def())
-                           .merge(_webserver_def()))
+                           .merge(_fuzz_def()).merge(_webserver_def()))
         props = dict(props or {})
         known = self.definition.keys()
         self.originals = props

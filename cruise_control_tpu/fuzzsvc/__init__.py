@@ -1,0 +1,46 @@
+"""fuzzsvc: property-based scenario fuzzer + chaos rebalance suite.
+
+See docs/FUZZING.md for the scenario taxonomy, invariant list, replay
+workflow, and corpus layout.
+"""
+
+from cruise_control_tpu.fuzzsvc.invariants import (
+    INVARIANTS,
+    InvariantResult,
+    Materialized,
+    run_invariants,
+)
+from cruise_control_tpu.fuzzsvc.runner import (
+    FuzzConfig,
+    FuzzReport,
+    ScenarioOutcome,
+    fuzz_sensors,
+    main,
+    run_fuzz,
+    run_one,
+    shrink,
+)
+from cruise_control_tpu.fuzzsvc.scenario import (
+    SCENARIO_KINDS,
+    Scenario,
+    StormEvent,
+    generate_scenario,
+    shrink_steps,
+)
+from cruise_control_tpu.fuzzsvc.storm import (
+    InProcessSimBackend,
+    StormReport,
+    audit_coherence,
+    build_storm_stack,
+    run_storm,
+)
+
+__all__ = [
+    "INVARIANTS", "InvariantResult", "Materialized", "run_invariants",
+    "FuzzConfig", "FuzzReport", "ScenarioOutcome", "fuzz_sensors", "main",
+    "run_fuzz", "run_one", "shrink",
+    "SCENARIO_KINDS", "Scenario", "StormEvent", "generate_scenario",
+    "shrink_steps",
+    "InProcessSimBackend", "StormReport", "audit_coherence",
+    "build_storm_stack", "run_storm",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from cruise_control_tpu.fuzzsvc.runner import main
+
+sys.exit(main())

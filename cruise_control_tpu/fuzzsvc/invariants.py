@@ -1,0 +1,302 @@
+"""Metamorphic invariant harness over :mod:`cruise_control_tpu.testing.verifier`.
+
+Absolute postconditions ("zero hard-goal violations") are wrong for an
+adversarial corpus — a scenario with two dead racks may be unsatisfiable
+by construction.  Every check here is therefore *relational*: the solve
+must never make things worse (hard goals, soft-goal stats), its output
+must be executable and conservative (proposals, loads), and independent
+execution strategies must agree (mesh vs single-chip, chunked vs
+unchunked lanes).  The last two are the safety net the ROADMAP's solver
+rewrites need: any kernel change that breaks parity fails EVERY scenario
+kind that carries the invariant, not just a hand-picked unit test.
+
+Each invariant is a function ``(Materialized) -> List[str]`` returning
+failure details (empty = holds); the registry keys are the names used in
+:data:`cruise_control_tpu.fuzzsvc.scenario.Scenario.invariants` and in
+docs/FUZZING.md.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.context import build_context, compute_aggregates
+from cruise_control_tpu.analyzer.goals.registry import goal_by_name
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.analyzer.options import OptimizationOptions
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.fuzzsvc.scenario import Scenario
+from cruise_control_tpu.model import ops
+from cruise_control_tpu.testing.verifier import verify_placement
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+    elapsed_s: float = 0.0
+
+    def __str__(self) -> str:
+        tag = "ok" if self.ok else "FAIL"
+        return f"{self.name}: {tag}" + (f" — {self.detail}" if self.detail else "")
+
+
+@dataclass
+class Materialized:
+    """One scenario's frozen snapshot plus the lazily-shared base solve.
+
+    Every invariant needs the same ``optimizations()`` result; computing it
+    once per scenario (instead of once per invariant) is what keeps an
+    8-scenario smoke inside the tier-1 timeout.
+    """
+
+    scenario: Scenario
+    state: object = None
+    placement: object = None
+    meta: object = None
+    _base: object = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.state is None:
+            self.state, self.placement, self.meta = self.scenario.materialize()
+
+    @property
+    def base(self):
+        if self._base is None:
+            opt = GoalOptimizer(goal_names=list(self.scenario.goal_names))
+            self._base = opt.optimizations(self.state, self.placement, self.meta)
+        return self._base
+
+    def goal_context(self, placement):
+        gctx = build_context(self.state, self.placement, self.meta,
+                             BalancingConstraint(), OptimizationOptions())
+        return gctx, compute_aggregates(gctx, placement)
+
+
+# --------------------------------------------------------------------------
+# base invariants (every scenario kind)
+# --------------------------------------------------------------------------
+
+def hard_goals_never_worsen(m: Materialized) -> List[str]:
+    """Per hard goal, the violated-broker count after the solve is <= the
+    count before it (metamorphic — the scenario may be unsatisfiable, but
+    a balancer must never manufacture NEW hard violations)."""
+    out: List[str] = []
+    final = m.base.final_placement
+    gctx, agg0 = m.goal_context(m.placement)
+    _, agg1 = m.goal_context(final)
+    for name in m.scenario.goal_names:
+        goal = goal_by_name(name)
+        if not goal.is_hard:
+            continue
+        before = int(np.sum(np.asarray(goal.violated_brokers(gctx, m.placement, agg0))))
+        after = int(np.sum(np.asarray(goal.violated_brokers(gctx, final, agg1))))
+        if after > before:
+            out.append(f"{name}: violated brokers {before} -> {after}")
+    return out
+
+
+def soft_goals_no_regression(m: Materialized) -> List[str]:
+    """The verifier's REGRESSION comparator over the base solve's per-goal
+    stats: no goal that actually ran may end with a worse metric."""
+    fails = verify_placement(
+        m.state, m.placement, m.meta, m.base.final_placement,
+        goal_names=(), verifications=("REGRESSION",),
+        goal_infos=m.base.goal_infos)
+    return [str(f) for f in fails if f.check == "REGRESSION"]
+
+
+def proposals_executable(m: Materialized) -> List[str]:
+    """Every emitted proposal must be executable against the model: old
+    replicas match the starting placement, new replicas are distinct known
+    alive brokers (on alive disks), and the new leader is in the new set."""
+    out: List[str] = []
+    n = m.meta.num_replicas
+    part = np.asarray(m.state.partition)[:n]
+    b0 = np.asarray(m.placement.broker)[:n]
+    l0 = np.asarray(m.placement.is_leader)[:n]
+    alive = np.asarray(m.state.alive)
+    disk_alive = np.asarray(m.state.disk_alive)
+    broker_ids = set(m.meta.broker_ids)
+    bindex = m.meta.broker_index
+
+    # (topic name, partition number) -> partition row id.
+    pid_of = {(m.meta.topics[t], pn): pid
+              for pid, (t, pn) in enumerate(m.meta.partitions)}
+
+    for prop in m.base.proposals:
+        tp = prop.topic_partition
+        pid = pid_of.get((tp.topic, tp.partition))
+        if pid is None:
+            out.append(f"{tp}: unknown partition")
+            continue
+        rows = np.nonzero(part == pid)[0]
+        have_old = {int(b) for b in b0[rows]}
+        said_old = {r.broker_id for r in prop.old_replicas}
+        if have_old != said_old:
+            out.append(f"{tp}: old replicas {sorted(said_old)} != "
+                       f"model placement {sorted(have_old)}")
+        leader_rows = rows[l0[rows]]
+        if leader_rows.size != 1 or int(b0[leader_rows[0]]) != prop.old_leader.broker_id:
+            out.append(f"{tp}: old leader {prop.old_leader.broker_id} "
+                       "does not match model leadership")
+        new = [r.broker_id for r in prop.new_replicas]
+        if len(set(new)) != len(new):
+            out.append(f"{tp}: duplicate brokers in new replicas {new}")
+        for r in prop.new_replicas:
+            if r.broker_id not in broker_ids:
+                out.append(f"{tp}: new replica on unknown broker {r.broker_id}")
+                continue
+            bi = bindex[r.broker_id]
+            if not alive[bi]:
+                out.append(f"{tp}: new replica on dead broker {r.broker_id}")
+            if r.logdir is not None and not disk_alive[bi, r.logdir]:
+                out.append(f"{tp}: new replica on dead disk "
+                           f"{r.broker_id}.{r.logdir}")
+        if prop.new_leader.broker_id not in set(new):
+            out.append(f"{tp}: new leader outside the new replica set")
+    return out
+
+
+def load_conservation(m: Materialized) -> List[str]:
+    """Applying the proposals moves load, never creates or destroys it:
+    exactly one leader per partition, replication-invariant resource
+    totals (disk, nw-in) conserved, and the verifier's LOAD_CONSISTENCY
+    recompute agrees with the jax aggregation."""
+    out: List[str] = []
+    final = m.base.final_placement
+    n = m.meta.num_replicas
+    part = np.asarray(m.state.partition)[:n]
+    leaders = np.bincount(part[np.asarray(final.is_leader)[:n]],
+                          minlength=len(m.meta.partitions))
+    bad = np.nonzero(leaders != 1)[0]
+    if bad.size:
+        out.append(f"{bad.size} partitions without exactly one leader "
+                   f"(first: p{int(bad[0])} has {int(leaders[bad[0]])})")
+    # Disk / NW_IN are identical for leaders and followers, so their
+    # cluster totals must survive any placement + leadership shuffle.
+    before = np.asarray(ops.broker_load(m.state, m.placement)).sum(axis=0)
+    after = np.asarray(ops.broker_load(m.state, final)).sum(axis=0)
+    for res in (Resource.DISK, Resource.NW_IN):
+        if not np.isclose(before[res], after[res], rtol=1e-4, atol=1e-3):
+            out.append(f"{res.name} total changed "
+                       f"{before[res]:.6g} -> {after[res]:.6g}")
+    out.extend(str(f) for f in verify_placement(
+        m.state, m.placement, m.meta, final, verifications=()))
+    return out
+
+
+# --------------------------------------------------------------------------
+# kind-specific invariants
+# --------------------------------------------------------------------------
+
+def stranded_cleared(m: Materialized) -> List[str]:
+    """Dead-broker / dead-disk scenarios: the solve must evacuate every
+    offline replica (the verifier's DEAD_BROKERS postcondition)."""
+    fails = verify_placement(
+        m.state, m.placement, m.meta, m.base.final_placement,
+        verifications=("DEAD_BROKERS",))
+    return [str(f) for f in fails if f.check == "DEAD_BROKERS"]
+
+
+def mesh_parity(m: Materialized) -> List[str]:
+    """solver(mesh) == solver(single-chip) on this scenario (same
+    violated-broker outcomes per goal; near-identical final CV)."""
+    import jax
+    from cruise_control_tpu.parallel import make_solver_mesh
+    n_dev = len(jax.devices())
+    if n_dev < 2 or m.scenario.pad_replicas_to % n_dev:
+        return []  # single device (or indivisible pad): nothing to compare
+    mesh = make_solver_mesh(n_dev)
+    sharded = GoalOptimizer(goal_names=list(m.scenario.goal_names),
+                            mesh=mesh).optimizations(
+        m.state, m.placement, m.meta)
+    out: List[str] = []
+    for b, s in zip(m.base.goal_infos, sharded.goal_infos):
+        if s.violated_brokers_after != b.violated_brokers_after:
+            out.append(f"{b.goal_name}: violated_after mesh="
+                       f"{s.violated_brokers_after} single={b.violated_brokers_after}")
+    cv_base = np.asarray(m.base.stats_after.cv())
+    cv_shard = np.asarray(sharded.stats_after.cv())
+    if not np.allclose(cv_shard, cv_base, rtol=0.05, atol=5e-3):
+        out.append(f"final CV diverged: mesh={cv_shard} single={cv_base}")
+    return out
+
+
+def chunked_parity(m: Materialized) -> List[str]:
+    """chunked == unchunked what-if lane solves on this scenario's
+    remove/add sets (exact equality: vmap lanes are independent, so lane
+    routing must be invisible)."""
+    from cruise_control_tpu.compilesvc import (
+        CompileService, ShapeBucketPolicy, compile_service, set_compile_service)
+    sets = m.scenario.whatif_remove or m.scenario.whatif_add
+    if not sets:
+        return []
+    # Two goals keep the per-variant compile cost bounded; parity over a
+    # subset of the stack is still parity of the lane-routing machinery.
+    goals = list(m.scenario.goal_names[:2])
+    batch = ("batch_remove_scenarios" if m.scenario.whatif_remove
+             else "batch_add_scenarios")
+    prev = compile_service()
+    try:
+        set_compile_service(CompileService(policy=ShapeBucketPolicy(max_lane_bucket=2)))
+        chunked = getattr(GoalOptimizer(goal_names=goals), batch)(
+            m.state, m.placement, m.meta, sets, num_candidates=64)
+        set_compile_service(CompileService(chunking_enabled=False))
+        plain = getattr(GoalOptimizer(goal_names=goals), batch)(
+            m.state, m.placement, m.meta, sets, num_candidates=64)
+    finally:
+        set_compile_service(prev)
+    out: List[str] = []
+    for name in ("violated_after", "moves", "stranded_after"):
+        a, b = np.asarray(getattr(chunked, name)), np.asarray(getattr(plain, name))
+        if not np.array_equal(a, b):
+            out.append(f"{batch}.{name}: chunked != unchunked")
+    for s in range(len(sets)):
+        a, b = chunked.placement_for(s), plain.placement_for(s)
+        if not (np.array_equal(np.asarray(a.broker), np.asarray(b.broker))
+                and np.array_equal(np.asarray(a.is_leader),
+                                   np.asarray(b.is_leader))):
+            out.append(f"lane {s}: final placement diverged")
+    return out
+
+
+INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
+    "hard_goals_never_worsen": hard_goals_never_worsen,
+    "soft_goals_no_regression": soft_goals_no_regression,
+    "proposals_executable": proposals_executable,
+    "load_conservation": load_conservation,
+    "stranded_cleared": stranded_cleared,
+    "mesh_parity": mesh_parity,
+    "chunked_parity": chunked_parity,
+}
+
+
+def run_invariants(scenario: Scenario,
+                   which: Optional[Sequence[str]] = None,
+                   materialized: Optional[Materialized] = None,
+                   ) -> List[InvariantResult]:
+    """Run the scenario's invariant set (or ``which``) and collect results;
+    an invariant that raises is itself a failure, not a crash of the run."""
+    m = materialized or Materialized(scenario)
+    results: List[InvariantResult] = []
+    for name in (which or scenario.invariants):
+        fn = INVARIANTS.get(name)
+        t0 = time.monotonic()
+        if fn is None:
+            results.append(InvariantResult(name, False, "unknown invariant"))
+            continue
+        try:
+            details = fn(m)
+        except Exception as exc:  # noqa: BLE001 — report, keep fuzzing
+            details = [f"raised {type(exc).__name__}: {exc}"]
+        results.append(InvariantResult(
+            name, ok=not details, detail="; ".join(details),
+            elapsed_s=time.monotonic() - t0))
+    return results

@@ -68,6 +68,11 @@ def build_app(config: CruiseControlConfig,
     # bounds / profile dir before any request or daemon can create spans.
     from cruise_control_tpu.obsvc import configure as configure_obsvc
     configure_obsvc(config)
+    # Materialize the Fuzz.* counters at boot: nightly fuzz campaigns share
+    # this registry, and the sensor-drift guard (scripts/check_sensors.py)
+    # requires every documented sensor to exist on a live scrape.
+    from cruise_control_tpu.fuzzsvc.runner import fuzz_sensors
+    fuzz_sensors()
     backend = demo_metadata()
     metadata_client = MetadataClient(backend,
                                      ttl_ms=config["metadata.max.age.ms"])

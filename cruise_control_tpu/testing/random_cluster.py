@@ -52,6 +52,35 @@ class ClusterProperties:
     num_disks: int = 1
     distribution: Distribution = Distribution.UNIFORM
     seed: int = 3140             # TestConstants.SEED_BASE
+    # ---- fuzzsvc extensions (defaults reproduce the reference layout) ----
+    # 0.0 = reference round-robin racks; > 0 skews broker counts across
+    # racks exponentially (rack 0 largest), so rack-aware goals face
+    # heterogeneous domains instead of perfectly even ones.
+    rack_skew: float = 0.0
+    # 1 = homogeneous capacity; k > 1 assigns brokers round-robin to k
+    # capacity tiers spanning 0.5x..1.5x of the reference capacity.
+    capacity_tiers: int = 1
+    # Explicit fault sets for deterministic scenario replay.  When given
+    # they take precedence over the sampled num_dead_brokers /
+    # num_brokers_with_bad_disk counts; dead_disk_ids works at any
+    # num_disks (the sampled path needs num_disks > 1).
+    dead_broker_ids: Optional[Tuple[int, ...]] = None
+    dead_disk_ids: Optional[Tuple[Tuple[int, int], ...]] = None
+
+
+def _apportion(weights: np.ndarray, total: int, min_each: int = 0) -> np.ndarray:
+    """Integer counts summing to ``total``, proportional to ``weights``
+    (largest-remainder), each at least ``min_each`` when feasible."""
+    n = weights.shape[0]
+    min_each = min(min_each, total // n) if n else 0
+    spread = total - min_each * n
+    share = weights / weights.sum() * spread
+    counts = np.floor(share).astype(np.int64)
+    remainder = spread - int(counts.sum())
+    if remainder > 0:
+        order = np.argsort(-(share - counts), kind="stable")
+        counts[order[:remainder]] += 1
+    return counts + min_each
 
 
 def _sample(rng: np.random.Generator, dist: Distribution, mean: float,
@@ -123,21 +152,39 @@ def generate(props: Optional[ClusterProperties] = None,
         leader_load[:, Resource.NW_IN], leader_load[:, Resource.NW_OUT],
         leader_load[:, Resource.CPU])
 
-    # ---- brokers: round-robin racks, one host per broker, homogeneous capacity.
+    # ---- brokers: racks (round-robin, or skewed per rack_skew), one host
+    # per broker, capacity homogeneous or tiered per capacity_tiers.
     capacity = np.tile(np.array([
         TYPICAL_CPU_CAPACITY, LARGE_BROKER_CAPACITY,
         MEDIUM_BROKER_CAPACITY, LARGE_BROKER_CAPACITY]), (p.num_brokers, 1))
-    rack = np.arange(p.num_brokers) % p.num_racks
+    if p.rack_skew > 0.0:
+        w = np.exp(-p.rack_skew * np.arange(p.num_racks)
+                   / max(p.num_racks - 1, 1))
+        counts = _apportion(w, p.num_brokers, min_each=1)
+        rack = np.repeat(np.arange(p.num_racks), counts)
+    else:
+        rack = np.arange(p.num_brokers) % p.num_racks
+    tier_mult = np.ones(p.num_brokers)
+    if p.capacity_tiers > 1:
+        tier = np.arange(p.num_brokers) % p.capacity_tiers
+        tier_mult = 0.5 + tier / (p.capacity_tiers - 1)
+        capacity = capacity * tier_mult[:, None]
     host = np.arange(p.num_brokers)
     alive = np.ones(p.num_brokers, dtype=bool)
-    if p.num_dead_brokers > 0:
+    if p.dead_broker_ids is not None:
+        alive[list(p.dead_broker_ids)] = False
+    elif p.num_dead_brokers > 0:
         dead = rng.choice(p.num_brokers, p.num_dead_brokers, replace=False)
         alive[dead] = False
 
     d_n = max(p.num_disks, 1)
-    disk_capacity = np.full((p.num_brokers, d_n), LARGE_BROKER_CAPACITY / d_n)
+    disk_capacity = (np.full((p.num_brokers, d_n), LARGE_BROKER_CAPACITY / d_n)
+                     * tier_mult[:, None])
     disk_alive = np.ones((p.num_brokers, d_n), dtype=bool)
-    if p.num_brokers_with_bad_disk > 0 and d_n > 1:
+    if p.dead_disk_ids is not None:
+        for b, d in p.dead_disk_ids:
+            disk_alive[int(b), int(d)] = False
+    elif p.num_brokers_with_bad_disk > 0 and d_n > 1:
         bad = rng.choice(np.nonzero(alive)[0],
                          min(p.num_brokers_with_bad_disk, int(alive.sum())),
                          replace=False)

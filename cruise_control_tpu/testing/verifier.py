@@ -50,22 +50,30 @@ class VerifyReport:
         return not self.failures
 
 
-def execute_goals_for(
+def verify_placement(
     state: ClusterState,
     placement: Placement,
     meta: ClusterMeta,
-    goal_names: Sequence[str],
+    final: Placement,
+    goal_names: Sequence[str] = (),
     constraint: Optional[BalancingConstraint] = None,
     options: Optional[OptimizationOptions] = None,
     verifications: Sequence[str] = ("GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION"),
-) -> VerifyReport:
-    """Run goals and verify (reference: OptimizationVerifier.executeGoalsFor)."""
+    goal_infos: Sequence = (),
+) -> List[VerificationFailure]:
+    """Postcondition checks over an arbitrary ``final`` placement.
+
+    The standalone oracle behind :func:`execute_goals_for`: callers that
+    already hold a solved (or deliberately broken) placement — the fuzz
+    harness, what-if lanes, failure-path tests — verify it directly without
+    re-running the optimizer.  Every violated check is reported (the list
+    accumulates; nothing short-circuits), so a multi-way breakage names all
+    of its causes at once.  ``goal_infos`` feeds the REGRESSION comparator
+    and may be empty when no per-goal stats exist.
+    """
     constraint = constraint or BalancingConstraint()
     options = options or OptimizationOptions()
-    optimizer = GoalOptimizer(constraint=constraint, goal_names=list(goal_names))
-    result = optimizer.optimizations(state, placement, meta, options=options)
-    report = VerifyReport(result=result)
-    final = result.final_placement
+    failures: List[VerificationFailure] = []
     gctx = build_context(state, placement, meta, constraint, options)
     agg = compute_aggregates(gctx, final)
 
@@ -76,19 +84,19 @@ def execute_goals_for(
             if goal.is_hard:
                 n = int(np.sum(np.asarray(goal.violated_brokers(gctx, final, agg))))
                 if n:
-                    report.failures.append(VerificationFailure(
+                    failures.append(VerificationFailure(
                         "GOAL_VIOLATION", f"hard goal {name} violated on {n} brokers"))
 
     if "DEAD_BROKERS" in verifications:
         stranded = int(np.sum(np.asarray(currently_offline(gctx, final))))
         if stranded:
-            report.failures.append(VerificationFailure(
+            failures.append(VerificationFailure(
                 "DEAD_BROKERS", f"{stranded} replicas still on dead brokers/disks"))
 
     if "REGRESSION" in verifications:
-        for info in result.goal_infos:
+        for info in goal_infos:
             if info.rounds > 0 and info.metric_after > info.metric_before * (1 + 1e-5):
-                report.failures.append(VerificationFailure(
+                failures.append(VerificationFailure(
                     "REGRESSION",
                     f"{info.goal_name} metric worsened "
                     f"{info.metric_before:.6g} -> {info.metric_after:.6g}"))
@@ -105,7 +113,7 @@ def execute_goals_for(
         bad &= ~offline  # offline replicas may go anywhere alive
         n_bad = int(bad.sum())
         if n_bad:
-            report.failures.append(VerificationFailure(
+            failures.append(VerificationFailure(
                 "NEW_BROKERS", f"{n_bad} healthy replicas moved to non-new brokers"))
 
     # Load-consistency invariant (ClusterModel.sanityCheck analog): the jax
@@ -120,7 +128,29 @@ def execute_goals_for(
     expect = np.zeros_like(bl)
     np.add.at(expect, np.asarray(final.broker), eff)
     if not np.allclose(bl, expect, rtol=1e-4, atol=1e-3):
-        report.failures.append(VerificationFailure(
+        failures.append(VerificationFailure(
             "LOAD_CONSISTENCY", "per-broker loads != numpy recompute from placement"))
 
+    return failures
+
+
+def execute_goals_for(
+    state: ClusterState,
+    placement: Placement,
+    meta: ClusterMeta,
+    goal_names: Sequence[str],
+    constraint: Optional[BalancingConstraint] = None,
+    options: Optional[OptimizationOptions] = None,
+    verifications: Sequence[str] = ("GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION"),
+) -> VerifyReport:
+    """Run goals and verify (reference: OptimizationVerifier.executeGoalsFor)."""
+    constraint = constraint or BalancingConstraint()
+    options = options or OptimizationOptions()
+    optimizer = GoalOptimizer(constraint=constraint, goal_names=list(goal_names))
+    result = optimizer.optimizations(state, placement, meta, options=options)
+    report = VerifyReport(result=result)
+    report.failures.extend(verify_placement(
+        state, placement, meta, result.final_placement,
+        goal_names=goal_names, constraint=constraint, options=options,
+        verifications=verifications, goal_infos=result.goal_infos))
     return report

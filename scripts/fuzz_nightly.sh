@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Nightly fuzz soak: a larger seed sweep than the tier-1 smoke, with chaos
+# storms enabled.  The tier-1 suite pins 8 fixed seeds (tests/test_fuzzsvc.py)
+# so CI stays deterministic; this script is where NEW seeds get explored.
+#
+# Usage:   ./scripts/fuzz_nightly.sh [num_scenarios] [base_seed]
+# Output:  one line per scenario; failing scenarios land in
+#          ${FUZZ_CORPUS_DIR:-.fuzz-corpus}/failing/*.json together with a
+#          shrunk *.min.json, and the replay one-liner is printed at the end.
+#
+# Pick base_seed from the date by default so every night covers fresh seeds
+# while any single night stays reproducible from its log line.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+NUM="${1:-64}"
+BASE="${2:-$(date +%Y%m%d)}"
+CORPUS="${FUZZ_CORPUS_DIR:-.fuzz-corpus}"
+
+echo "[fuzz-nightly] ${NUM} scenarios from base seed ${BASE} -> ${CORPUS}"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m cruise_control_tpu.fuzzsvc \
+    --num "${NUM}" \
+    --base-seed "${BASE}" \
+    --storm-cycles "${FUZZ_STORM_CYCLES:-2}" \
+    --budget-s "${FUZZ_BUDGET_S:-120}" \
+    --corpus-dir "${CORPUS}"

@@ -185,13 +185,15 @@ def test_cache_dir_carries_every_version_axis(tmp_path):
 
 
 def test_cache_activate_cold_then_warm(tmp_path, jax_cache_config_guard):
-    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True,
+                                   cpu_probe=False)
     assert cache.activate("cpu", "stackA", "R64-C64") is False
     assert cache.active_dir is not None
     # Simulate an XLA write-through, then a fresh process at the same key.
     with open(os.path.join(cache.active_dir, "xla_entry.bin"), "wb") as f:
         f.write(b"\x00" * 64)
-    cache2 = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    cache2 = PersistentCompileCache(root=str(tmp_path), enabled=True,
+                                    cpu_probe=False)
     assert cache2.activate("cpu", "stackA", "R64-C64") is True
     assert cache2.stats()["entries"] == 1
     # A different goal stack or bucket is a different (cold) directory.
@@ -201,7 +203,8 @@ def test_cache_activate_cold_then_warm(tmp_path, jax_cache_config_guard):
 
 def test_cache_quarantines_unreadable_manifest(tmp_path,
                                                jax_cache_config_guard):
-    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True,
+                                   cpu_probe=False)
     path = cache.cache_dir("cpu", "stackA", "R64-C64")
     os.makedirs(path)
     with open(os.path.join(path, "cc-cache-manifest.json"), "w") as f:
@@ -219,7 +222,8 @@ def test_cache_quarantines_unreadable_manifest(tmp_path,
 
 def test_cache_quarantines_version_mismatch(tmp_path,
                                             jax_cache_config_guard):
-    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True,
+                                   cpu_probe=False)
     path = cache.cache_dir("cpu", "stackA", "R64-C64")
     os.makedirs(path)
     with open(os.path.join(path, "cc-cache-manifest.json"), "w") as f:
@@ -432,3 +436,123 @@ def test_chunked_batch_matches_unchunked(fresh_service):
         np.testing.assert_array_equal(np.asarray(a.is_leader),
                                       np.asarray(b.is_leader))
         assert chunked.quality(s) == plain.quality(s)
+
+
+# ------------------------------------------------------- cpu loader probe
+
+def _stub_probe(verdict):
+    """An injectable probe runner recording its calls."""
+    calls = []
+
+    def run(workdir, timeout_s):
+        calls.append(workdir)
+        return verdict
+
+    return run, calls
+
+
+def test_probe_memoizes_verdict_per_host(tmp_path):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+    ok_run, ok_calls = _stub_probe(True)
+    assert probe_cpu_cache_loader(str(tmp_path), runner=ok_run) is True
+    assert len(ok_calls) == 1
+    # Marker carries the verdict: a later (even contradictory) runner never
+    # executes until the memo is refreshed.
+    fail_run, fail_calls = _stub_probe(False)
+    assert probe_cpu_cache_loader(str(tmp_path), runner=fail_run) is True
+    assert fail_calls == []
+    assert probe_cpu_cache_loader(str(tmp_path), runner=fail_run,
+                                  refresh=True) is False
+    assert len(fail_calls) == 1
+    assert probe_cpu_cache_loader(str(tmp_path), runner=ok_run) is False
+
+
+def test_probe_marker_keys_on_jaxlib_and_fingerprint(tmp_path):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+    run, _ = _stub_probe(True)
+    probe_cpu_cache_loader(str(tmp_path), runner=run)
+    marker = (tmp_path / f"v{SCHEMA_VERSION}" /
+              f"cpu-probe-{jaxlib_version()}-{machine_fingerprint()}.json")
+    assert marker.exists()
+    data = json.loads(marker.read_text())
+    assert data == {"ok": True, "jaxlib": jaxlib_version(),
+                    "fingerprint": machine_fingerprint()}
+
+
+def test_probe_runner_exception_means_unsupported(tmp_path):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+
+    def boom(workdir, timeout_s):
+        raise RuntimeError("child died")
+
+    assert probe_cpu_cache_loader(str(tmp_path), runner=boom) is False
+
+
+def test_activate_gates_cpu_on_failed_probe(tmp_path, jax_cache_config_guard):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+    fail_run, _ = _stub_probe(False)
+    probe_cpu_cache_loader(str(tmp_path), runner=fail_run)   # memoize "no"
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    assert cache.activate("cpu", "stackA", "R64-C64") is False
+    assert cache.active_dir is None    # never touched jax.config
+
+
+def test_activate_proceeds_on_passed_probe(tmp_path, jax_cache_config_guard):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+    ok_run, _ = _stub_probe(True)
+    probe_cpu_cache_loader(str(tmp_path), runner=ok_run)     # memoize "yes"
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    assert cache.activate("cpu", "stackA", "R64-C64") is False   # cold
+    assert cache.active_dir is not None
+
+
+def test_activate_probe_opt_out_restores_blind_trust(tmp_path,
+                                                     jax_cache_config_guard):
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True,
+                                   cpu_probe=False)
+    cache.activate("cpu", "stackA", "R64-C64")
+    assert cache.active_dir is not None
+    # No probe marker was ever written.
+    assert not list((tmp_path / f"v{SCHEMA_VERSION}").glob("cpu-probe-*"))
+
+
+def test_activate_never_probes_non_cpu(tmp_path, jax_cache_config_guard):
+    from cruise_control_tpu.compilesvc.cache import probe_cpu_cache_loader
+    fail_run, _ = _stub_probe(False)
+    probe_cpu_cache_loader(str(tmp_path), runner=fail_run)   # memoize "no"
+    cache = PersistentCompileCache(root=str(tmp_path), enabled=True)
+    cache.activate("tpu", "stackA", "R64-C64")               # gate is CPU-only
+    assert cache.active_dir is not None
+
+
+def test_configure_env_default_on(fresh_service, monkeypatch):
+    from cruise_control_tpu.compilesvc import configure
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    monkeypatch.setenv("CC_TPU_PERSIST_CACHE", "1")
+    svc = configure(CruiseControlConfig({}))
+    assert svc.cache.enabled is True
+    # A path-valued env var doubles as the cache root.
+    monkeypatch.setenv("CC_TPU_PERSIST_CACHE", "/tmp/cc-cache-root")
+    svc = configure(CruiseControlConfig({}))
+    assert svc.cache.enabled is True
+    assert svc.cache.root == "/tmp/cc-cache-root"
+    set_compile_service(None)
+
+
+def test_configure_explicit_config_beats_env(fresh_service, monkeypatch):
+    from cruise_control_tpu.compilesvc import configure
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    monkeypatch.setenv("CC_TPU_PERSIST_CACHE", "1")
+    svc = configure(CruiseControlConfig(
+        {"compile.persistent.cache.enabled": False}))
+    assert svc.cache.enabled is False
+    monkeypatch.delenv("CC_TPU_PERSIST_CACHE")
+    svc = configure(CruiseControlConfig(
+        {"compile.persistent.cache.cpu.probe": False}))
+    assert svc.cache.cpu_probe is False
+    assert svc.cache.enabled is False   # env unset: config default stands
+    set_compile_service(None)

@@ -1,0 +1,236 @@
+"""Tier-1 smoke for fuzzsvc: fixed-seed scenario corpus, invariants, one
+storm cycle, shrinker + replay, and the random_cluster extensions it rides on.
+
+Budget discipline: every smoke scenario shares one padded shape
+(1024 replicas / 16 brokers) and one goal stack, so the 8-kind sweep pays
+one solver compile and reuses it seven times.  The long chaos soak lives
+behind ``@pytest.mark.slow`` (scripts/fuzz_nightly.sh).
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.metrics import registry
+from cruise_control_tpu.fuzzsvc import invariants as fuzz_invariants
+from cruise_control_tpu.fuzzsvc import runner as fuzz_runner
+from cruise_control_tpu.fuzzsvc.runner import (
+    FuzzConfig,
+    fuzz_sensors,
+    run_fuzz,
+    run_one,
+)
+from cruise_control_tpu.fuzzsvc.scenario import (
+    SCENARIO_KINDS,
+    SMOKE_GOALS,
+    Scenario,
+    generate_scenario,
+    shrink_steps,
+)
+from cruise_control_tpu.fuzzsvc.storm import audit_coherence, run_storm
+from cruise_control_tpu.testing import random_cluster as rc
+
+SMOKE_BASE_SEED = 100
+
+
+# --------------------------------------------------------------- generator
+
+class TestScenarioGenerator:
+    def test_seed_determinism(self):
+        a = generate_scenario(123)
+        b = generate_scenario(123)
+        assert a.to_json() == b.to_json()
+        assert generate_scenario(124).to_json() != a.to_json()
+
+    def test_forced_kind_keeps_stream(self):
+        # The kind is drawn from the stream even when forced, so the rest of
+        # the scenario (topic/replica counts) matches the bare-seed draw.
+        free = generate_scenario(55)
+        forced = generate_scenario(55, kind=free.kind)
+        assert forced.to_json() == free.to_json()
+
+    @pytest.mark.parametrize("kind", SCENARIO_KINDS)
+    def test_every_kind_shapes_its_scenario(self, kind):
+        s = generate_scenario(77, kind=kind)
+        assert s.kind == kind and s.name == f"{kind}-s77"
+        assert list(s.goal_names) == list(SMOKE_GOALS)
+        if kind == "dead_brokers":
+            assert len(s.props.dead_broker_ids) == 2
+            assert "stranded_cleared" in s.invariants
+        elif kind == "dead_disks":
+            assert s.props.num_disks == 3
+            assert len(s.props.dead_disk_ids) == 2
+        elif kind == "maintenance_window":
+            assert s.events and s.events[0].plan == "remove_broker"
+        elif kind == "broker_add":
+            assert s.whatif_add and "chunked_parity" in s.invariants
+        elif kind == "broker_remove":
+            assert s.whatif_remove and "chunked_parity" in s.invariants
+        elif kind == "hetero_racks":
+            assert s.props.rack_skew > 0 and s.props.capacity_tiers == 3
+        elif kind == "exp_skew":
+            assert s.props.distribution is rc.Distribution.EXPONENTIAL
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario kind"):
+            generate_scenario(1, kind="nope")
+
+    @pytest.mark.parametrize("kind",
+                             ["dead_disks", "maintenance_window", "broker_add"])
+    def test_json_roundtrip(self, kind):
+        s = generate_scenario(31, kind=kind)
+        back = Scenario.from_json(s.to_json())
+        assert back.to_json() == s.to_json()
+        assert back.props == s.props   # enums/tuples restored, not strings
+
+    def test_replay_command_forms(self):
+        s = generate_scenario(9, kind="exp_skew")
+        assert s.replay_command().endswith("--seed 9 --kind exp_skew")
+        assert "--replay /tmp/x.json" in s.replay_command("/tmp/x.json")
+
+    def test_shrink_steps_strictly_simpler(self):
+        s = generate_scenario(77, kind="dead_disks")
+        labels = [label for label, _ in shrink_steps(s)]
+        assert len(labels) == len(set(labels))
+        assert "halve-topics" in labels and "halve-replicas" in labels
+        assert any(label.startswith("drop-dead-disk-") for label in labels)
+        for _, cand in shrink_steps(s):
+            assert cand.to_json() != s.to_json()
+
+
+# ------------------------------------------------- random_cluster extensions
+
+class TestRandomClusterExtensions:
+    SMALL = dict(num_brokers=8, num_racks=4, num_topics=6, num_replicas=60,
+                 min_replication=3, max_replication=3, seed=5)
+
+    def test_rack_skew_apportions_all_brokers(self):
+        state, _, _ = rc.generate(
+            rc.ClusterProperties(**self.SMALL, rack_skew=2.0),
+            pad_replicas_to=64, pad_brokers_to=8)
+        sizes = np.bincount(np.asarray(state.rack)[:8], minlength=4)
+        assert sizes.sum() == 8 and (sizes >= 1).all()
+        assert sizes.max() > sizes.min()   # skew produced unequal racks
+
+    def test_capacity_tiers_differentiate_brokers(self):
+        state, _, _ = rc.generate(
+            rc.ClusterProperties(**self.SMALL, capacity_tiers=3),
+            pad_replicas_to=64, pad_brokers_to=8)
+        per_broker = np.asarray(state.disk_capacity)[:8].sum(axis=1)
+        assert len(np.unique(np.round(per_broker, 3))) >= 2
+
+    def test_explicit_dead_ids_take_precedence(self):
+        state, _, _ = rc.generate(
+            rc.ClusterProperties(**self.SMALL, num_disks=2,
+                                 dead_broker_ids=(2,),
+                                 dead_disk_ids=((4, 1),)),
+            pad_replicas_to=64, pad_brokers_to=8)
+        alive = np.asarray(state.alive)[:8]
+        assert not alive[2] and alive[[0, 1, 3, 4, 5, 6, 7]].all()
+        disk_alive = np.asarray(state.disk_alive)[:8]
+        assert not disk_alive[4, 1] and disk_alive[4, 0]
+
+    def test_defaults_leave_cluster_healthy(self):
+        state, _, _ = rc.generate(rc.ClusterProperties(**self.SMALL),
+                                  pad_replicas_to=64, pad_brokers_to=8)
+        assert np.asarray(state.alive)[:8].all()
+        assert np.asarray(state.disk_alive)[:8].all()
+
+
+# -------------------------------------------------------------- smoke sweep
+
+class TestFuzzSmoke:
+    @pytest.mark.parametrize("i,kind", list(enumerate(SCENARIO_KINDS)))
+    def test_fixed_seed_corpus_invariants(self, i, kind):
+        """The acceptance smoke: 8 fixed-seed scenarios (one per kind), every
+        scenario's full invariant set — mesh/chunked parity included."""
+        out = run_one(generate_scenario(SMOKE_BASE_SEED + i, kind=kind),
+                      storm_cycles=0)
+        assert out.ok, f"{kind}: {out.failures}"
+
+    def test_storm_cycle_converges_with_coherent_audit(self):
+        rep = run_storm(generate_scenario(205, kind="maintenance_window"),
+                        cycles=1)
+        assert rep.ok, rep.problems
+        assert rep.cycles_run == 1
+        assert rep.anomalies_detected >= 1
+        assert rep.audit, "storm must leave an audit trail"
+        assert audit_coherence(rep.audit) == []
+
+    def test_fuzz_counters_advance(self):
+        sensors = fuzz_sensors()
+        before = {k: c.count for k, c in sensors.items()}
+        # Warm seed/kind from the parametrized sweep, one cheap invariant:
+        # this test is about the counters, not the solve.
+        out = run_one(generate_scenario(SMOKE_BASE_SEED,
+                                        kind=SCENARIO_KINDS[0]),
+                      storm_cycles=0, which=("load_conservation",))
+        assert out.ok
+        assert sensors["scenarios"].count == before["scenarios"] + 1
+        assert sensors["failures"].count == before["failures"]
+        assert registry().counter("Fuzz.scenarios-run") is sensors["scenarios"]
+
+
+# ------------------------------------------------- shrinker + replay loop
+
+class TestShrinkAndReplay:
+    def test_injected_failure_shrinks_and_replays(self, tmp_path, monkeypatch):
+        # Break every invariant lookup: run_invariants reports unknown names
+        # as failures, so each scenario fails cheaply (no solver involved).
+        monkeypatch.setattr(fuzz_invariants, "INVARIANTS", {})
+        logs = []
+        cfg = FuzzConfig(num_scenarios=1, base_seed=42, storm_cycles=0,
+                         corpus_dir=str(tmp_path / "corpus"),
+                         shrink_max_steps=3, kinds=("hetero_racks",))
+        report = run_fuzz(cfg, log=logs.append)
+        assert not report.ok
+        assert report.replay_lines
+
+        # The failing scenario and its shrunk form are both on disk.
+        saved = sorted((tmp_path / "corpus" / "failing").glob("*.json"))
+        assert any(p.name.endswith(".min.json") for p in saved)
+        shrunk = next(p for p in saved if p.name.endswith(".min.json"))
+        assert Scenario.from_json(shrunk.read_text()).kind == "hetero_racks"
+        assert any("shrunk via" in line for line in logs)
+
+        # The printed replay command reproduces the failure bit-for-bit.
+        replay = next(line for line in report.replay_lines
+                      if "--replay" in line)
+        path = replay.split("--replay ", 1)[1].split()[0]
+        rc_code = fuzz_runner.main(["--replay", path, "--storm-cycles", "0"])
+        assert rc_code == 1
+
+        # ... and so does the bare --seed/--kind form.
+        bare = next(line for line in report.replay_lines
+                    if "--seed" in line)
+        args = bare.split("cruise_control_tpu.fuzzsvc ", 1)[1].split()
+        assert fuzz_runner.main(args + ["--storm-cycles", "0"]) == 1
+
+    def test_cli_list_kinds(self, capsys):
+        assert fuzz_runner.main(["--list-kinds"]) == 0
+        assert capsys.readouterr().out.split() == list(SCENARIO_KINDS)
+
+    def test_fuzz_config_from_cc_config(self):
+        from cruise_control_tpu.config.cruise_control_config import (
+            CruiseControlConfig,
+        )
+        cfg = FuzzConfig.from_cc_config(CruiseControlConfig(
+            {"fuzz.num.scenarios": 3, "fuzz.storm.cycles": 0,
+             "fuzz.corpus.dir": "/tmp/fz"}))
+        assert cfg.num_scenarios == 3
+        assert cfg.storm_cycles == 0
+        assert cfg.corpus_dir == "/tmp/fz"
+        assert cfg.base_seed == 100   # defaulted from the config def
+
+
+# ------------------------------------------------------------ nightly soak
+
+@pytest.mark.slow
+class TestStormSoak:
+    def test_multi_cycle_storm_every_kind(self, tmp_path):
+        cfg = FuzzConfig(num_scenarios=len(SCENARIO_KINDS), base_seed=300,
+                         storm_cycles=2, corpus_dir=str(tmp_path / "corpus"))
+        report = run_fuzz(cfg, log=lambda *_: None)
+        assert report.ok, [f for o in report.outcomes for f in o.failures]

@@ -1,0 +1,211 @@
+"""Failure paths of testing/verifier.verify_placement.
+
+Each VerificationFailure check gets a purpose-built broken placement that
+makes it — and only the intended checks — fire.  The final test breaks a
+placement three ways at once and asserts the verifier names every cause
+(accumulation, not first-failure short-circuit), which is the contract the
+fuzz harness leans on when classifying a failing scenario.
+
+JAX_PLATFORMS=cpu; shapes are tiny (64 replicas / 8 brokers) so the whole
+module compiles in a few seconds.
+"""
+
+import dataclasses
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.model import ops
+from cruise_control_tpu.testing import random_cluster as rc
+from cruise_control_tpu.testing.verifier import VerificationFailure, verify_placement
+
+SMALL = dict(num_brokers=6, num_racks=3, num_topics=5, num_replicas=48,
+             min_replication=3, max_replication=3, mean_cpu=0.02,
+             num_disks=1, seed=11)
+PADS = dict(pad_replicas_to=64, pad_brokers_to=8)
+
+
+@pytest.fixture(scope="module")
+def healthy():
+    props = rc.ClusterProperties(**SMALL)
+    return rc.generate(props, **PADS)
+
+
+@pytest.fixture(scope="module")
+def with_dead_broker():
+    props = rc.ClusterProperties(**SMALL, dead_broker_ids=(1,))
+    return rc.generate(props, **PADS)
+
+
+def _with_broker(placement, broker_arr):
+    return dataclasses.replace(placement, broker=jnp.asarray(
+        np.asarray(broker_arr, dtype=np.int32)))
+
+
+def _all_on_broker_zero(state, placement):
+    """Every valid replica co-located on broker 0 — maximally rack-unaware,
+    yet load-consistent (loads are recomputed from the final placement)."""
+    valid = np.asarray(state.valid)
+    broker = np.asarray(placement.broker).copy()
+    broker[valid] = 0
+    return _with_broker(placement, broker)
+
+
+def _info(name="ReplicaDistributionGoal", rounds=1, before=1.0, after=1.0):
+    return SimpleNamespace(goal_name=name, rounds=rounds,
+                           metric_before=before, metric_after=after)
+
+
+class TestIndividualChecks:
+    def test_clean_placement_passes_every_check(self, healthy):
+        state, placement, meta = healthy
+        # The random initial placement is not rack-aware by construction, so
+        # build one that is: replica pos k of every partition lands on the
+        # first broker of rack k (3 racks, RF=3 -> all racks distinct).
+        rack = np.asarray(state.rack)[:6]
+        first_in_rack = np.array([int(np.flatnonzero(rack == k)[0])
+                                  for k in range(3)])
+        broker = np.asarray(placement.broker).copy()
+        valid = np.asarray(state.valid)
+        broker[valid] = first_in_rack[np.asarray(state.pos)[valid] % 3]
+        final = _with_broker(placement, broker)
+        failures = verify_placement(
+            state, placement, meta, final,
+            goal_names=("RackAwareGoal",),
+            verifications=("GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION",
+                           "NEW_BROKERS"),
+            goal_infos=(_info(before=2.0, after=1.5),))
+        assert failures == []
+
+    def test_goal_violation_fires_on_colocated_replicas(self, healthy):
+        state, placement, meta = healthy
+        final = _all_on_broker_zero(state, placement)
+        failures = verify_placement(
+            state, placement, meta, final,
+            goal_names=("RackAwareGoal",), verifications=("GOAL_VIOLATION",))
+        assert [f.check for f in failures] == ["GOAL_VIOLATION"]
+        assert "RackAwareGoal" in failures[0].detail
+        # VerificationFailure is an AssertionError rendering "[CHECK] detail".
+        assert isinstance(failures[0], AssertionError)
+        assert str(failures[0]).startswith("[GOAL_VIOLATION]")
+
+    def test_dead_brokers_fires_on_stranded_replicas(self, with_dead_broker):
+        state, placement, meta = with_dead_broker
+        stranded = int(np.sum(
+            (np.asarray(placement.broker) == 1) & np.asarray(state.valid)))
+        assert stranded > 0, "generator must leave replicas on the dead broker"
+        failures = verify_placement(
+            state, placement, meta, placement, verifications=("DEAD_BROKERS",))
+        assert [f.check for f in failures] == ["DEAD_BROKERS"]
+        assert str(stranded) in failures[0].detail
+
+    def test_dead_brokers_passes_once_evacuated(self, with_dead_broker):
+        state, placement, meta = with_dead_broker
+        valid = np.asarray(state.valid)
+        broker = np.asarray(placement.broker).copy()
+        broker[valid & (broker == 1)] = 0   # evacuate the dead broker
+        failures = verify_placement(
+            state, placement, meta, _with_broker(placement, broker),
+            verifications=("DEAD_BROKERS",))
+        assert failures == []
+
+    def test_regression_fires_only_on_worsened_rounds(self, healthy):
+        state, placement, meta = healthy
+        infos = (
+            _info("GoalA", rounds=1, before=1.0, after=2.0),   # worsened
+            _info("GoalB", rounds=0, before=1.0, after=9.0),   # rounds==0: skip
+            _info("GoalC", rounds=3, before=1.0, after=1.0),   # unchanged: ok
+        )
+        failures = verify_placement(
+            state, placement, meta, placement,
+            verifications=("REGRESSION",), goal_infos=infos)
+        assert [f.check for f in failures] == ["REGRESSION"]
+        assert "GoalA" in failures[0].detail and "GoalB" not in failures[0].detail
+
+    def test_new_brokers_fires_on_move_to_old_broker(self, healthy):
+        state, placement, meta = healthy
+        new_broker = np.zeros(int(np.asarray(state.broker_valid).shape[0]),
+                              dtype=bool)
+        new_broker[4] = True
+        state_nb = dataclasses.replace(state,
+                                       new_broker=jnp.asarray(new_broker))
+        broker = np.asarray(placement.broker).copy()
+        r = int(np.flatnonzero(np.asarray(state.valid) & (broker != 2))[0])
+        broker[r] = 2   # healthy replica moved to an OLD broker
+        failures = verify_placement(
+            state_nb, placement, meta, _with_broker(placement, broker),
+            verifications=("NEW_BROKERS",))
+        assert [f.check for f in failures] == ["NEW_BROKERS"]
+
+    def test_new_brokers_allows_moves_onto_new_broker(self, healthy):
+        state, placement, meta = healthy
+        new_broker = np.zeros(int(np.asarray(state.broker_valid).shape[0]),
+                              dtype=bool)
+        new_broker[4] = True
+        state_nb = dataclasses.replace(state,
+                                       new_broker=jnp.asarray(new_broker))
+        broker = np.asarray(placement.broker).copy()
+        r = int(np.flatnonzero(np.asarray(state.valid) & (broker != 4))[0])
+        broker[r] = 4   # moving TO the new broker is the sanctioned direction
+        failures = verify_placement(
+            state_nb, placement, meta, _with_broker(placement, broker),
+            verifications=("NEW_BROKERS",))
+        assert failures == []
+
+    def test_new_brokers_vacuous_without_new_brokers(self, healthy):
+        state, placement, meta = healthy
+        broker = np.asarray(placement.broker).copy()
+        r = int(np.flatnonzero(np.asarray(state.valid))[0])
+        broker[r] = (int(broker[r]) + 1) % 6
+        failures = verify_placement(
+            state, placement, meta, _with_broker(placement, broker),
+            verifications=("NEW_BROKERS",))
+        assert failures == []
+
+    def test_load_consistency_always_runs(self, healthy, monkeypatch):
+        state, placement, meta = healthy
+        real = ops.broker_load
+        monkeypatch.setattr(ops, "broker_load",
+                            lambda s, p: np.asarray(real(s, p)) + 1.0)
+        failures = verify_placement(
+            state, placement, meta, placement, verifications=())
+        assert [f.check for f in failures] == ["LOAD_CONSISTENCY"]
+
+    def test_empty_verifications_runs_only_load_consistency(self, healthy):
+        state, placement, meta = healthy
+        # Placement broken for every opt-in check — but with verifications=()
+        # only the always-on load invariant runs, and it recomputes from the
+        # final placement, so nothing fires.
+        final = _all_on_broker_zero(state, placement)
+        failures = verify_placement(
+            state, placement, meta, final, goal_names=("RackAwareGoal",),
+            verifications=(), goal_infos=(_info(after=99.0),))
+        assert failures == []
+
+
+class TestAccumulation:
+    def test_multi_way_breakage_reports_every_check(self, with_dead_broker,
+                                                    monkeypatch):
+        """One placement broken four ways -> four distinct checks reported."""
+        state, placement, meta = with_dead_broker
+        valid = np.asarray(state.valid)
+        broker = np.asarray(placement.broker).copy()
+        # Co-locate partition 0's replicas on broker 0 (GOAL_VIOLATION) while
+        # leaving the dead broker 1's replicas stranded (DEAD_BROKERS).
+        broker[valid & (np.asarray(state.partition) == 0)] = 0
+        final = _with_broker(placement, broker)
+        real = ops.broker_load
+        monkeypatch.setattr(ops, "broker_load",
+                            lambda s, p: np.asarray(real(s, p)) + 1.0)
+        failures = verify_placement(
+            state, placement, meta, final,
+            goal_names=("RackAwareGoal",),
+            verifications=("GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION"),
+            goal_infos=(_info("GoalA", rounds=1, before=1.0, after=2.0),))
+        checks = [f.check for f in failures]
+        assert set(checks) == {"GOAL_VIOLATION", "DEAD_BROKERS", "REGRESSION",
+                               "LOAD_CONSISTENCY"}
+        assert len(checks) == 4, "every violated check reported exactly once"
+        assert all(isinstance(f, VerificationFailure) for f in failures)
