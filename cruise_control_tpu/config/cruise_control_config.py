@@ -306,6 +306,47 @@ def _fuzz_def() -> ConfigDef:
     return d
 
 
+def _resilience_def() -> ConfigDef:
+    """resilience keys (retry budgets, admin-backend circuit breaker, crash
+    journal, /health).  No single reference analog — the reference leans on
+    the JVM AdminClient's internal retries; here the transport is ours, so
+    the failure policy is operator-visible config."""
+    d = ConfigDef()
+    d.define("resilience.retry.max.attempts", ConfigType.INT, 4,
+             range_validator(1),
+             doc="attempts per admin-backend call before the retry budget "
+                 "is exhausted")
+    d.define("resilience.retry.base.delay.ms", ConfigType.LONG, 100,
+             range_validator(1),
+             doc="first-retry backoff; later retries multiply by 2 with "
+                 "±50% jitter")
+    d.define("resilience.retry.max.delay.ms", ConfigType.LONG, 5_000,
+             range_validator(1), doc="backoff ceiling per sleep")
+    d.define("resilience.retry.deadline.ms", ConfigType.LONG, 30_000,
+             range_validator(1),
+             doc="wall-clock budget across all attempts of one logical call")
+    d.define("resilience.circuit.failure.threshold", ConfigType.INT, 5,
+             range_validator(1),
+             doc="consecutive backend failures that open the circuit")
+    d.define("resilience.circuit.reset.timeout.ms", ConfigType.LONG, 10_000,
+             range_validator(1),
+             doc="open-circuit hold before a half-open probe is allowed")
+    d.define("resilience.backend.reconnect.enabled", ConfigType.BOOLEAN, True,
+             doc="wrap the socket admin backend in the reconnecting/"
+                 "circuit-breaking transport")
+    d.define("resilience.journal.path", ConfigType.STRING, "",
+             doc="crash-safe execution journal file; empty disables "
+                 "journaling (and startup reconciliation)")
+    d.define("resilience.journal.adoption.timeout.ms", ConfigType.LONG,
+             30_000, range_validator(1),
+             doc="startup budget for waiting on re-adopted in-flight "
+                 "reassignments before declaring them still-in-flight")
+    d.define("resilience.health.retry.after.s", ConfigType.INT, 30,
+             range_validator(1),
+             doc="Retry-After header value on 503s while unhealthy")
+    return d
+
+
 def _webserver_def() -> ConfigDef:
     d = ConfigDef()
     d.define("webserver.http.port", ConfigType.INT, 9090)
@@ -367,7 +408,8 @@ class CruiseControlConfig:
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
                            .merge(_compile_def()).merge(_trace_def())
-                           .merge(_fuzz_def()).merge(_webserver_def()))
+                           .merge(_fuzz_def()).merge(_resilience_def())
+                           .merge(_webserver_def()))
         props = dict(props or {})
         known = self.definition.keys()
         self.originals = props

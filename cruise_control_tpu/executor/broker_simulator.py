@@ -29,7 +29,9 @@ milliseconds.
 from __future__ import annotations
 
 import json
+import random
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 TP = Tuple[str, int]
@@ -62,6 +64,13 @@ class BrokerSimulator:
         self.config_log: List[Dict] = []
         self.max_inflight = 0
         self.max_inflight_per_broker: Dict[int, int] = {}
+        # Transport-level fault injection (op_chaos / --chaos-* flags):
+        # per-request probabilities of added latency, a swallowed reply
+        # (client read times out), or a connection reset.  Seeded so chaos
+        # runs replay.
+        self.chaos: Dict[str, float] = {"delay_p": 0.0, "delay_ms": 0.0,
+                                        "drop_p": 0.0, "reset_p": 0.0}
+        self._chaos_rng = random.Random(0)
 
     # ------------------------------------------------------------- handlers
 
@@ -269,6 +278,33 @@ class BrokerSimulator:
                     str(b): n for b, n in self.max_inflight_per_broker.items()},
                 "config_log": self.config_log}
 
+    def op_chaos(self, req):
+        """Set the fault-injection knobs over the wire (any subset); replies
+        with the resulting configuration.  ``seed`` re-seeds the chaos RNG so
+        a storm run is replayable from its seed alone."""
+        for k in ("delay_p", "delay_ms", "drop_p", "reset_p"):
+            if k in req:
+                self.chaos[k] = float(req[k])
+        if "seed" in req:
+            self._chaos_rng = random.Random(int(req["seed"]))
+        return {"chaos": dict(self.chaos)}
+
+    def chaos_action(self, op: Optional[str]) -> Optional[str]:
+        """Roll the chaos dice for one request: None (serve normally),
+        "drop" (swallow the request, send no reply), or "reset" (close the
+        connection mid-protocol).  Control-plane ops are immune so a test
+        can always re-arm/disarm chaos and shut the simulator down."""
+        if op in _CHAOS_IMMUNE:
+            return None
+        ch, rng = self.chaos, self._chaos_rng
+        if ch["delay_p"] > 0 and rng.random() < ch["delay_p"]:
+            time.sleep(ch["delay_ms"] / 1000.0)
+        if ch["reset_p"] > 0 and rng.random() < ch["reset_p"]:
+            return "reset"
+        if ch["drop_p"] > 0 and rng.random() < ch["drop_p"]:
+            return "drop"
+        return None
+
     def op_ping(self, req):
         return {}
 
@@ -279,8 +315,14 @@ class BrokerSimulator:
         return {}
 
 
-def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
-    """Drain one JSON-lines stream; True when a shutdown op arrived."""
+# Ops exempt from fault injection: chaos must stay steerable, auth failures
+# must be deterministic, and a shutdown/bootstrap must always land.
+_CHAOS_IMMUNE = {"chaos", "auth", "shutdown", "bootstrap", None}
+
+
+def _serve_stream(sim: "BrokerSimulator", lines, write) -> Optional[str]:
+    """Drain one JSON-lines stream; returns "shutdown" when a shutdown op
+    arrived, "reset" when chaos cut the connection, None on EOF."""
     for line in lines:
         line = line.strip()
         if not line:
@@ -292,11 +334,16 @@ def _serve_stream(sim: "BrokerSimulator", lines, write) -> bool:
             continue
         if req.get("op") == "shutdown":
             write(json.dumps({"id": req.get("id"), "ok": True}) + "\n")
-            return True
+            return "shutdown"
+        action = sim.chaos_action(req.get("op"))
+        if action == "reset":
+            return "reset"
+        if action == "drop":
+            continue
         resp = sim.handle(req)
         resp["id"] = req.get("id")
         write(json.dumps(resp) + "\n")
-    return False
+    return None
 
 
 def _serve_tcp(sim: "BrokerSimulator", port: int,
@@ -379,8 +426,11 @@ def _serve_tcp(sim: "BrokerSimulator", port: int,
                         return
                     write(json.dumps(
                         {"id": req.get("id"), "ok": True}) + "\n")
-                if _serve_stream(sim, rfile, write):
+                outcome = _serve_stream(sim, rfile, write)
+                if outcome == "shutdown":
                     shutdown_evt.set()
+                # "reset": fall through — closing the socket mid-protocol
+                # IS the injected fault the client observes.
             except OSError:
                 # Unclean client disconnect (reset mid-read, broken pipe on
                 # reply) must not kill the listener — cluster state survives
@@ -420,6 +470,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if "--polls-to-finish" in args:
         polls = int(args[args.index("--polls-to-finish") + 1])
     sim = BrokerSimulator(polls_to_finish=polls)
+    # Fault injection from the command line (same knobs as op_chaos), so a
+    # chaos soak needs no control connection to arm the faults.
+    for flag, key in (("--chaos-delay-p", "delay_p"),
+                      ("--chaos-delay-ms", "delay_ms"),
+                      ("--chaos-drop-p", "drop_p"),
+                      ("--chaos-reset-p", "reset_p")):
+        if flag in args:
+            sim.chaos[key] = float(args[args.index(flag) + 1])
+    if "--chaos-seed" in args:
+        sim._chaos_rng = random.Random(
+            int(args[args.index("--chaos-seed") + 1]))
     if "--listen" in args:
         token = None
         if "--auth-token-file" in args:

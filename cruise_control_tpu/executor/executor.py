@@ -21,7 +21,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 from cruise_control_tpu.common.actions import ExecutionProposal
 from cruise_control_tpu.common.exceptions import OngoingExecutionError
 from cruise_control_tpu.executor.backend import ClusterAdminBackend
+from cruise_control_tpu.executor.journal import ExecutionJournal
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.subprocess_backend import (
+    BackendCircuitOpenError,
+    BackendTransportError,
+)
 from cruise_control_tpu.executor.strategies import AbstractReplicaMovementStrategy
 from cruise_control_tpu.executor.tasks import (
     ExecutionTask,
@@ -45,6 +50,9 @@ class ExecutorState(enum.Enum):
     INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "INTER_BROKER_REPLICA_MOVEMENT"
     INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "INTRA_BROKER_REPLICA_MOVEMENT"
     LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT"
+    # Admin-backend circuit open: in-flight work is held (not rotted to the
+    # alert timeout) while the reconnecting backend probes for recovery.
+    PAUSED_BACKEND_DOWN = "PAUSED_BACKEND_DOWN"
     STOPPING_EXECUTION = "STOPPING_EXECUTION"
 
 
@@ -106,6 +114,9 @@ class Executor:
         self._pause_sampling: Optional[Callable[[], None]] = None
         self._resume_sampling: Optional[Callable[[], None]] = None
         self._generating_proposals_for_execution = False
+        self.journal: Optional[ExecutionJournal] = None
+        self.recovering = False
+        self.last_journal_recovery: Optional[Dict] = None
         self._register_sensors()
 
     def _register_sensors(self) -> None:
@@ -143,6 +154,10 @@ class Executor:
         self._sensor_stopped = reg.counter("Executor.execution-stopped")
         self._sensor_stopped_by_user = reg.counter(
             "Executor.execution-stopped-by-user")
+        # Materialized backend-failure visibility: every backend exception
+        # the executor absorbs lands here, long before the alert timeout
+        # would have made the damage visible as DEAD tasks.
+        self._sensor_backend_errors = reg.counter("Executor.backend-errors")
 
     # ------------------------------------------------------------- wiring
 
@@ -176,12 +191,17 @@ class Executor:
             self._generating_proposals_for_execution = flag
 
     def state_summary(self) -> Dict:
-        return {
+        out = {
             "state": self.state.value,
             "tasks": self.tracker.summary(),
             "finishedDataMovementMB": round(self.tracker.finished_data_movement_mb, 3),
             "concurrency": self.adjuster.current,
         }
+        if self.recovering:
+            out["journalRecovery"] = {"status": "recovering"}
+        elif self.last_journal_recovery is not None:
+            out["journalRecovery"] = self.last_journal_recovery
+        return out
 
     # ------------------------------------------------------------ execute
 
@@ -201,8 +221,15 @@ class Executor:
             self._stop_requested.clear()
             self._planner = ExecutionTaskPlanner(self._strategy)
             total = min(len(proposals), self.config.max_num_cluster_movements)
-            for t in self._planner.add_proposals(list(proposals)[:total]):
+            accepted = list(self._planner.add_proposals(list(proposals)[:total]))
+            for t in accepted:
                 self.tracker.add(t)
+            if self.journal is not None:
+                try:
+                    self.journal.begin_batch(accepted)
+                except OSError:
+                    LOG.exception("journal begin_batch failed; executing "
+                                  "without crash protection")
             # Audit-log deltas are against this execution's start (the
             # tracker itself is lifetime-cumulative).
             self._exec_baseline = (
@@ -241,6 +268,124 @@ class Executor:
             if self._state is not ExecutorState.STOPPING_EXECUTION:
                 self._state = s
 
+    def _transition(self, task: ExecutionTask, to: ExecutionTaskState) -> None:
+        """Tracker transition + write-ahead journal record (when enabled)."""
+        self.tracker.transition(task, to, self._now_ms())
+        if self.journal is not None:
+            try:
+                self.journal.record_transition(task, to)
+            except OSError:
+                LOG.exception("journal transition write failed")
+
+    def _backend_error(self, seam: str, exc: BaseException) -> None:
+        """Materialize an absorbed backend failure (Executor.backend-errors)
+        so peers dying is visible on /metrics before any alert timeout."""
+        self._sensor_backend_errors.inc()
+        LOG.debug("backend error at %s: %s", seam, exc, exc_info=exc)
+
+    def _paused_wait(self, resume_state: ExecutorState) -> bool:
+        """Hold the execution in PAUSED_BACKEND_DOWN while the reconnecting
+        backend's circuit is open, probing for recovery.  True: backend is
+        back, state restored to ``resume_state``.  False: a stop was
+        requested while paused."""
+        probe = getattr(self.backend, "probe", None)
+        self._set_state(ExecutorState.PAUSED_BACKEND_DOWN)
+        OPERATION_LOG.info("execution paused: admin backend circuit open")
+        while not self._stop_requested.is_set():
+            if probe is None or probe():
+                self._set_state(resume_state)
+                OPERATION_LOG.info(
+                    "execution resumed: admin backend recovered")
+                return True
+            time.sleep(max(self.config.progress_check_interval_s, 0.01))
+        return False
+
+    # ----------------------------------------------------- journal recovery
+
+    def set_journal(self, journal: Optional[ExecutionJournal]) -> None:
+        self.journal = journal
+
+    def recover_from_journal(self, adoption_timeout_s: float = 30.0
+                             ) -> Optional[Dict]:
+        """Replay the write-ahead journal against the live backend: tasks
+        the crashed process left non-terminal are re-adopted (still moving
+        on the cluster — watch them drain), completed (no longer in
+        progress: they finished while we were down), or rolled back (never
+        submitted).  The summary is surfaced in /state as
+        ``journalRecovery``; the journal file is retired unless the backend
+        was unreachable (then it is kept for the next restart)."""
+        if self.journal is None:
+            return None
+        replay = self.journal.replay()
+        if replay is None:
+            return None
+        self.recovering = True
+        summary: Dict = {"batchId": replay.batch_id,
+                         "journaledTasks": len(replay.tasks),
+                         "reAdopted": 0, "completed": 0, "rolledBack": 0,
+                         "stillInFlight": 0}
+        try:
+            orphans = replay.orphans()
+            if replay.complete or not orphans:
+                summary["status"] = "clean"
+                return summary
+            try:
+                in_prog = set(self.backend.in_progress_reassignments())
+            except Exception as exc:  # noqa: BLE001 — backend down at boot
+                self._backend_error("journal-recovery", exc)
+                summary["status"] = "backend-unavailable"
+                LOG.warning("journal recovery: backend unavailable; keeping "
+                            "the journal for the next restart")
+                return summary
+            adopted = [t for t in orphans
+                       if t.last_state == ExecutionTaskState.IN_PROGRESS.value
+                       and t.topic_partition in in_prog]
+            for t in orphans:
+                if t in adopted:
+                    continue
+                if t.last_state == ExecutionTaskState.PENDING.value:
+                    summary["rolledBack"] += 1
+                else:
+                    # Submitted but no longer on the cluster: it finished
+                    # while we were down.
+                    summary["completed"] += 1
+            # Rebuild live tasks for the adopted set: real transports only
+            # advance a reassignment when it is polled with finished(), so
+            # the adoption loop must actively drive them, not just watch
+            # in_progress_reassignments shrink.
+            live = {t.execution_id: t.to_execution_task() for t in adopted}
+            deadline = self._clock() + adoption_timeout_s
+            while (adopted and self._clock() < deadline
+                   and not self._stop_requested.is_set()):
+                time.sleep(max(self.config.progress_check_interval_s, 0.01))
+                try:
+                    for t in adopted:
+                        self.backend.finished(live[t.execution_id])
+                    in_prog = set(self.backend.in_progress_reassignments())
+                except Exception as exc:  # noqa: BLE001 — peer flapping
+                    self._backend_error("journal-recovery", exc)
+                    break
+                drained = [t for t in adopted
+                           if t.topic_partition not in in_prog]
+                summary["reAdopted"] += len(drained)
+                adopted = [t for t in adopted if t.topic_partition in in_prog]
+            summary["stillInFlight"] = len(adopted)
+            summary["status"] = "reconciled"
+            OPERATION_LOG.info(
+                "journal recovery: batch %d — reAdopted=%d completed=%d "
+                "rolledBack=%d stillInFlight=%d", replay.batch_id,
+                summary["reAdopted"], summary["completed"],
+                summary["rolledBack"], summary["stillInFlight"])
+            return summary
+        finally:
+            self.recovering = False
+            self.last_journal_recovery = summary
+            if summary.get("status") != "backend-unavailable":
+                try:
+                    self.journal.mark_recovered()
+                except OSError:
+                    LOG.exception("failed to retire the recovered journal")
+
     def _run(self) -> None:
         # Root span: the execution thread has no request context, so each
         # batch is its own trace (phases + outcome counts as attrs).
@@ -263,17 +408,16 @@ class Executor:
                         self.config.replication_throttle_bytes_per_s, throttled,
                         throttled_brokers,
                         proposals=[t.proposal for t in inter])
-                except Exception:  # noqa: BLE001 — same dead-peer policy as
-                    # the movement submits: abort the execution with the
-                    # planned tasks marked DEAD, not a dead thread with every
-                    # task stuck PENDING.
+                except Exception as exc:  # noqa: BLE001 — same dead-peer
+                    # policy as the movement submits: abort the execution
+                    # with the planned tasks marked DEAD, not a dead thread
+                    # with every task stuck PENDING.
+                    self._backend_error("set-throttles", exc)
                     LOG.exception("throttle setup failed; aborting execution")
                     for t in self._planner.clear():
                         if t.state is ExecutionTaskState.PENDING:
-                            self.tracker.transition(
-                                t, ExecutionTaskState.IN_PROGRESS, self._now_ms())
-                            self.tracker.transition(
-                                t, ExecutionTaskState.DEAD, self._now_ms())
+                            self._transition(t, ExecutionTaskState.IN_PROGRESS)
+                            self._transition(t, ExecutionTaskState.DEAD)
                     return
             tr = _obsvc_tracer()
             self._set_state(
@@ -299,14 +443,13 @@ class Executor:
             if self._stop_requested.is_set() and self._planner is not None:
                 for t in self._planner.clear():
                     if t.state is ExecutionTaskState.PENDING:
-                        self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
-                                                self._now_ms())
-                        self.tracker.transition(t, ExecutionTaskState.DEAD,
-                                                self._now_ms())
+                        self._transition(t, ExecutionTaskState.IN_PROGRESS)
+                        self._transition(t, ExecutionTaskState.DEAD)
             if self.config.replication_throttle_bytes_per_s:
                 try:
                     self.backend.clear_throttles()
-                except Exception:  # noqa: BLE001 — the finally must finish
+                except Exception as exc:  # noqa: BLE001 — finally must finish
+                    self._backend_error("clear-throttles", exc)
                     LOG.exception("failed to clear replication throttles")
             if self._resume_sampling:
                 self._resume_sampling()
@@ -340,6 +483,14 @@ class Executor:
                 dead=counts[ExecutionTaskState.DEAD],
                 aborted=counts[ExecutionTaskState.ABORTED],
                 moved_mb=moved_mb)
+            if self.journal is not None:
+                try:
+                    self.journal.end_batch(
+                        {"completed": counts[ExecutionTaskState.COMPLETED],
+                         "dead": counts[ExecutionTaskState.DEAD],
+                         "aborted": counts[ExecutionTaskState.ABORTED]})
+                except OSError:
+                    LOG.exception("journal end_batch failed")
             for fn in self._on_finish:
                 try:
                     fn()
@@ -353,11 +504,52 @@ class Executor:
         return (self.adjuster.current if self.config.auto_adjust_concurrency
                 else self.config.concurrent_partition_movements_per_broker)
 
+    def _submit_batch(self, batch: List[ExecutionTask], submit_fn,
+                      resume_state: ExecutorState) -> bool:
+        """Submit one movement batch.  An open backend circuit pauses the
+        execution and retries the same batch after recovery; any other
+        failure marks the batch DEAD (the reference's task-dead handling,
+        Executor.java:1457-1540).  False: the batch did not go out."""
+        while not self._stop_requested.is_set():
+            try:
+                submit_fn(batch)
+                return True
+            except BackendCircuitOpenError as exc:
+                self._backend_error("submit", exc)
+                if not self._paused_wait(resume_state):
+                    break              # stop requested while paused
+            except Exception as exc:  # noqa: BLE001 — backend/peer failure
+                self._backend_error("submit", exc)
+                LOG.exception("movement submission failed; marking %d "
+                              "tasks dead", len(batch))
+                for t in batch:
+                    self._transition(t, ExecutionTaskState.IN_PROGRESS)
+                    self._transition(t, ExecutionTaskState.DEAD)
+                if self.config.auto_adjust_concurrency:
+                    self.adjuster.on_distress()
+                return False
+        # Stop requested before the batch went out: it is no longer in the
+        # planner (batch_fn popped it), so account for it here.
+        for t in batch:
+            if t.state is ExecutionTaskState.PENDING:
+                self._transition(t, ExecutionTaskState.IN_PROGRESS)
+                self._transition(t, ExecutionTaskState.DEAD)
+        return False
+
+    def _extend_alert_windows(self, tasks: Sequence[ExecutionTask]) -> None:
+        """A backend outage must not count against in-flight tasks' alert
+        timeout — restart their clocks at resume."""
+        now = self._now_ms()
+        for t in tasks:
+            if t.state is ExecutionTaskState.IN_PROGRESS:
+                t.start_time_ms = now
+
     def _move_replicas(self, task_type: TaskType, batch_fn, submit_fn,
                        per_broker_cap: int) -> None:
         """Batched movement loop (interBrokerMoveReplicas :1163-1225)."""
         in_flight: Dict[int, int] = {}
         active: List[ExecutionTask] = []
+        resume_state = self.state
         while not self._stop_requested.is_set():
             cap = (self._concurrency()
                    if task_type is TaskType.INTER_BROKER_REPLICA_ACTION
@@ -365,26 +557,10 @@ class Executor:
             ready = {b: cap for t in self._all_brokers(task_type) for b in [t]}
             batch = batch_fn(ready, in_flight)
             if batch:
-                try:
-                    submit_fn(batch)
-                except Exception:  # noqa: BLE001 — backend/peer failure
-                    # Submission failed (admin peer dead, protocol error):
-                    # the batch is DEAD, not stuck — mirrors the reference's
-                    # task-dead handling (Executor.java:1457-1540) instead of
-                    # killing the progress thread.
-                    LOG.exception("movement submission failed; marking %d "
-                                  "tasks dead", len(batch))
-                    for t in batch:
-                        self.tracker.transition(
-                            t, ExecutionTaskState.IN_PROGRESS, self._now_ms())
-                        self.tracker.transition(
-                            t, ExecutionTaskState.DEAD, self._now_ms())
-                    if self.config.auto_adjust_concurrency:
-                        self.adjuster.on_distress()
+                if not self._submit_batch(batch, submit_fn, resume_state):
                     continue
                 for t in batch:
-                    self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
-                                            self._now_ms())
+                    self._transition(t, ExecutionTaskState.IN_PROGRESS)
                     for b in t.brokers_involved:
                         in_flight[b] = in_flight.get(b, 0) + 1
                 active.extend(batch)
@@ -394,29 +570,43 @@ class Executor:
                 continue
             time.sleep(self.config.progress_check_interval_s)
             still_active: List[ExecutionTask] = []
-            for t in active:
-                if self.backend.finished(t):
-                    self.tracker.transition(t, ExecutionTaskState.COMPLETED,
-                                            self._now_ms())
+            paused = False
+            for idx, t in enumerate(active):
+                try:
+                    fin = self.backend.finished(t)
+                except BackendCircuitOpenError as exc:
+                    self._backend_error("progress-poll", exc)
+                    if self._paused_wait(resume_state):
+                        self._extend_alert_windows(active)
+                    # This task and everything unprocessed stay active; the
+                    # outer loop re-polls (or aborts on stop).
+                    still_active.extend(active[idx:])
+                    paused = True
+                    break
+                except BackendTransportError as exc:
+                    self._backend_error("progress-poll", exc)
+                    fin = False
+                if fin:
+                    self._transition(t, ExecutionTaskState.COMPLETED)
                     for b in t.brokers_involved:
                         in_flight[b] = max(in_flight.get(b, 0) - 1, 0)
                 elif (self._now_ms() - t.start_time_ms
                       > self.config.task_execution_alert_timeout_s * 1000):
-                    self.tracker.transition(t, ExecutionTaskState.DEAD,
-                                            self._now_ms())
+                    self._transition(t, ExecutionTaskState.DEAD)
                     for b in t.brokers_involved:
                         in_flight[b] = max(in_flight.get(b, 0) - 1, 0)
                     if self.config.auto_adjust_concurrency:
                         self.adjuster.on_distress()
                 else:
                     still_active.append(t)
-            if self.config.auto_adjust_concurrency and not still_active:
+            if (not paused and self.config.auto_adjust_concurrency
+                    and not still_active):
                 self.adjuster.on_healthy()
             active = still_active
         # Stop requested: abort whatever is in flight.
         for t in active:
-            self.tracker.transition(t, ExecutionTaskState.ABORTING, self._now_ms())
-            self.tracker.transition(t, ExecutionTaskState.ABORTED, self._now_ms())
+            self._transition(t, ExecutionTaskState.ABORTING)
+            self._transition(t, ExecutionTaskState.ABORTED)
 
     def _planner_queue_empty(self, task_type: TaskType) -> bool:
         if task_type is TaskType.INTER_BROKER_REPLICA_ACTION:
@@ -439,41 +629,42 @@ class Executor:
                 self.config.concurrent_leader_movements)
             if not batch:
                 break
-            try:
-                self.backend.execute_preferred_leader_election(batch)
-            except Exception:  # noqa: BLE001 — same dead-peer handling as moves
-                LOG.exception("leadership submission failed; marking %d "
-                              "tasks dead", len(batch))
-                for t in batch:
-                    self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
-                                            self._now_ms())
-                    self.tracker.transition(t, ExecutionTaskState.DEAD,
-                                            self._now_ms())
+            if not self._submit_batch(
+                    batch, self.backend.execute_preferred_leader_election,
+                    ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS):
                 continue
             for t in batch:
-                self.tracker.transition(t, ExecutionTaskState.IN_PROGRESS,
-                                        self._now_ms())
+                self._transition(t, ExecutionTaskState.IN_PROGRESS)
             pending = list(batch)
             while pending and not self._stop_requested.is_set():
                 time.sleep(self.config.progress_check_interval_s)
                 still = []
-                for t in pending:
-                    if self._maybe_complete(t):
-                        continue
+                for idx, t in enumerate(pending):
+                    try:
+                        if self._maybe_complete(t):
+                            continue
+                    except BackendCircuitOpenError as exc:
+                        self._backend_error("progress-poll", exc)
+                        if self._paused_wait(
+                                ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS):
+                            self._extend_alert_windows(pending)
+                        still.extend(pending[idx:])
+                        break
+                    except BackendTransportError as exc:
+                        self._backend_error("progress-poll", exc)
                     # Same dead-task timeout as the replica loops: a peer
                     # that dies after a successful election submit reads as
                     # finished()=False forever, and without this branch the
                     # executor would stay in LEADER_MOVEMENT for good.
                     if (self._now_ms() - t.start_time_ms
                             > self.config.task_execution_alert_timeout_s * 1000):
-                        self.tracker.transition(t, ExecutionTaskState.DEAD,
-                                                self._now_ms())
+                        self._transition(t, ExecutionTaskState.DEAD)
                     else:
                         still.append(t)
                 pending = still
 
     def _maybe_complete(self, t: ExecutionTask) -> bool:
         if self.backend.finished(t):
-            self.tracker.transition(t, ExecutionTaskState.COMPLETED, self._now_ms())
+            self._transition(t, ExecutionTaskState.COMPLETED)
             return True
         return False

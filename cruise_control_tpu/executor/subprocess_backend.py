@@ -43,6 +43,14 @@ class BackendTransportError(RuntimeError):
     """The admin peer died or broke protocol."""
 
 
+class BackendCircuitOpenError(BackendTransportError):
+    """The admin-backend circuit breaker is open: the call was refused
+    without touching the transport.  Raised by the reconnecting wrapper
+    (``resilience/reconnect.py``); defined here, next to its base, so the
+    executor can catch it without importing the resilience package (which
+    imports this module)."""
+
+
 class SubprocessClusterBackend:
     """ClusterAdminBackend over a child process speaking JSON lines."""
 
@@ -209,7 +217,8 @@ class SubprocessClusterBackend:
         return {int(b): [int(x) for x in dirs]
                 for b, dirs in resp.get("offline", {}).items()}
 
-    def finished(self, task: ExecutionTask) -> bool:
+    def finished(self, task: ExecutionTask,
+                 raise_transport_errors: bool = False) -> bool:
         p = task.proposal
         try:
             if task.task_type is TaskType.LEADER_ACTION:
@@ -220,6 +229,10 @@ class SubprocessClusterBackend:
                     for old, _ in p.replicas_to_move_between_disks)
             return self._is_done("reassign", p)
         except BackendTransportError:
+            if raise_transport_errors:
+                # The reconnecting wrapper wants the raw signal: it decides
+                # between rebuilding the transport and pausing the executor.
+                raise
             # Let the executor's alert-timeout mark the task dead instead of
             # blowing up the progress loop (Executor.java:1457-1540).
             return False

@@ -19,12 +19,14 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from cruise_control_tpu import resilience as _resilience
 from cruise_control_tpu.analyzer import (
     BalancingConstraint,
     GoalOptimizer,
     OptimizationOptions,
     OptimizerResult,
 )
+from cruise_control_tpu.common.metrics import registry as _metric_registry
 from cruise_control_tpu.analyzer.goals.registry import DEFAULT_GOALS
 from cruise_control_tpu.common.exceptions import OngoingExecutionError, UserRequestError
 from cruise_control_tpu.detector.anomalies import (
@@ -47,7 +49,8 @@ from cruise_control_tpu.detector.detectors import (
 )
 from cruise_control_tpu.detector.manager import AnomalyDetectorManager
 from cruise_control_tpu.detector.notifier import NoopNotifier, SelfHealingNotifier
-from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.executor import (Executor, ExecutorConfig,
+                                                  ExecutorState)
 from cruise_control_tpu.model.builder import ClusterModel
 from cruise_control_tpu.model.stats import compute_stats
 from cruise_control_tpu.monitor.load_monitor import (
@@ -74,9 +77,14 @@ class OperationResult:
     dryrun: bool
     executed: bool
     info: str = ""
+    # True when the solve fell back to the CPU backend after a device
+    # failure — the answer is correct but slower-path; operators alert on it.
+    degraded: bool = False
 
     def to_dict(self) -> Dict:
         d = {"dryrun": self.dryrun, "executed": self.executed, "info": self.info}
+        if self.degraded:
+            d["degraded"] = True
         if self.optimizer_result is not None:
             d["result"] = self.optimizer_result.to_dict()
         return d
@@ -137,6 +145,10 @@ class CruiseControl:
         # request never pays cold-compile latency.  Built lazily in start_up
         # only when the compile service has warmup enabled.
         self.warmup_daemon = None
+        # Wall-clock of the last solve that needed the CPU fallback; cleared
+        # by the next clean solve.  Feeds the /health device probe.
+        self._solver_degraded_at: Optional[float] = None
+        self._journal_recovery_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -163,6 +175,22 @@ class CruiseControl:
         if compile_service().warmup_enabled:
             self.warmup_daemon = self._build_warmup_daemon()
             self.warmup_daemon.start()
+        if getattr(self.executor, "journal", None) is not None:
+            # Reconcile the crash journal off the startup path: the admin
+            # peer may itself be down, and /health reports the in-progress
+            # recovery as degraded until it lands.
+            timeout_s = (_resilience.settings().journal_adoption_timeout_ms
+                         / 1000.0)
+            self._journal_recovery_thread = threading.Thread(
+                target=self._recover_journal, args=(timeout_s,),
+                name="journal-recovery", daemon=True)
+            self._journal_recovery_thread.start()
+
+    def _recover_journal(self, timeout_s: float) -> None:
+        try:
+            self.executor.recover_from_journal(adoption_timeout_s=timeout_s)
+        except Exception:  # noqa: BLE001 — recovery must never kill startup
+            LOG.exception("journal recovery failed")
 
     def shutdown(self) -> None:
         if self.warmup_daemon is not None:
@@ -423,16 +451,16 @@ class CruiseControl:
                                             goal_names=goals))
             generation = (self.load_monitor.model_generation
                           if use_cached and model_mutator is None else None)
-            result = optimizer.optimizations(
-                state, placement, meta, options=options,
-                model_generation=generation)
+            result, degraded = self._solve_with_failover(
+                optimizer, state, placement, meta, options, generation)
             executed = False
             if not dryrun and result.proposals:
                 self.executor.execute_proposals(result.proposals, wait=False)
                 executed = True
             elif not dryrun:
                 self.executor.set_generating_proposals_for_execution(False)
-            return OperationResult(result, dryrun=dryrun, executed=executed)
+            return OperationResult(result, dryrun=dryrun, executed=executed,
+                                   degraded=degraded)
         except Exception:
             if not dryrun:
                 try:
@@ -440,6 +468,41 @@ class CruiseControl:
                 except OngoingExecutionError:
                     pass
             raise
+
+    def _solve_with_failover(self, optimizer, state, placement, meta,
+                             options, generation):
+        """Dispatch the solve; on device loss, fail over to the CPU backend.
+
+        The accelerator can die mid-flight (preemption, driver crash, XLA
+        runtime abort).  A rebalance answer computed on CPU is identical —
+        just slower — so catch device-loss-shaped errors at this one seam,
+        re-run under ``jax.default_device(cpu)``, and tag the response +
+        trace span ``degraded`` so operators see the path taken.  The cache
+        generation is dropped for the retry: the cached entry may itself be
+        poisoned by the dead device.
+        """
+        try:
+            result = optimizer.optimizations(
+                state, placement, meta, options=options,
+                model_generation=generation)
+            self._solver_degraded_at = None
+            return result, False
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not _resilience.is_device_failure(exc):
+                raise
+            _metric_registry().counter(
+                _resilience.SOLVER_FAILOVER_SENSOR).inc()
+            LOG.error("accelerator failure during solve (%s: %s); "
+                      "retrying on CPU backend", type(exc).__name__, exc)
+        span = _obsvc_tracer().current()
+        if span is not None:
+            span.set("degraded", True)
+        with _resilience.cpu_fallback():
+            result = optimizer.optimizations(
+                state, placement, meta, options=options,
+                model_generation=None)
+        self._solver_degraded_at = time.time()
+        return result, True
 
     def proposals(self, goals: Optional[Sequence[str]] = None,
                   options: Optional[OptimizationOptions] = None) -> OperationResult:
@@ -621,3 +684,80 @@ class CruiseControl:
                     {"name": g, "status": "ready"} for g in self.default_goals],
             },
         }
+
+    def health(self) -> Dict:
+        """GET /health — per-component probes with a ready/degraded/unhealthy
+        rollup.  Cheap by construction (no solve, no model build): a load
+        balancer polls this every few seconds.
+
+        Probe semantics:
+          * ``model``     — completeness floor met → ready; else degraded
+            (goal operations would be rejected, reads still serve).
+          * ``backend``   — admin circuit CLOSED → ready, HALF_OPEN →
+            degraded, OPEN or executor in PAUSED_BACKEND_DOWN → unhealthy.
+          * ``device``    — last solve needed the CPU fallback → degraded.
+          * ``journal``   — startup reconciliation running or un-reconciled
+            orphans on disk → degraded.
+        """
+        probes: Dict[str, Dict] = {}
+
+        # -- model freshness
+        model_status, detail = "ready", {}
+        try:
+            if self.default_completeness is not None:
+                if not self.load_monitor.meet_completeness_requirements(
+                        self.default_completeness):
+                    model_status = "degraded"
+                    detail["reason"] = "completeness requirements not met"
+        except Exception as e:  # noqa: BLE001 — a probe never raises
+            model_status, detail = "degraded", {"reason": str(e)}
+        probes["model"] = {"status": model_status, **detail}
+
+        # -- admin backend circuit
+        circuit = (getattr(self.executor.backend, "circuit", None)
+                   or _resilience.backend_circuit())
+        backend_status, detail = "ready", {}
+        if circuit is not None:
+            snap = circuit.snapshot()
+            detail = {"circuit": snap}
+            if snap["state"] == "open":
+                backend_status = "unhealthy"
+            elif snap["state"] == "half_open":
+                backend_status = "degraded"
+        if self.executor.state is ExecutorState.PAUSED_BACKEND_DOWN:
+            backend_status = "unhealthy"
+            detail["reason"] = "executor paused: backend down"
+        probes["backend"] = {"status": backend_status, **detail}
+
+        # -- accelerator liveness (observed, not probed: poking the device
+        # from the health path could itself wedge on a dead accelerator)
+        if self._solver_degraded_at is not None:
+            probes["device"] = {
+                "status": "degraded",
+                "reason": "solver on CPU fallback",
+                "sinceMs": int(self._solver_degraded_at * 1000)}
+        else:
+            probes["device"] = {"status": "ready"}
+
+        # -- crash journal
+        journal_status, detail = "ready", {}
+        if self.executor.recovering:
+            journal_status = "degraded"
+            detail["reason"] = "journal reconciliation in progress"
+        else:
+            journal = getattr(self.executor, "journal", None)
+            if journal is not None:
+                try:
+                    lag = journal.lag()
+                except OSError as e:
+                    lag, detail = 0, {"reason": str(e)}
+                if lag:
+                    journal_status = "degraded"
+                    detail = {"reason": "un-reconciled journaled tasks",
+                              "lag": lag}
+        probes["journal"] = {"status": journal_status, **detail}
+
+        order = {"ready": 0, "degraded": 1, "unhealthy": 2}
+        worst = max((p["status"] for p in probes.values()),
+                    key=lambda s: order[s])
+        return {"status": worst, "probes": probes}

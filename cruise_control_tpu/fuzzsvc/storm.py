@@ -13,6 +13,9 @@ end the obsvc audit ring must tell a coherent detector→action→outcome story.
 
 from __future__ import annotations
 
+import json
+import subprocess
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -71,9 +74,24 @@ class InProcessSimBackend(SubprocessClusterBackend):
 class StormStack:
     cc: CruiseControl
     metadata: FakeMetadataBackend
-    sim: BrokerSimulator
-    backend: InProcessSimBackend
+    # In-process transport only; None when the simulator runs out-of-process.
+    sim: Optional[BrokerSimulator]
+    # InProcessSimBackend, or a ReconnectingBackend over the real socket
+    # transport (transport="socket") — both expose request()/describe_topics.
+    backend: object
     num_brokers: int
+    transport: str = "inprocess"
+    proc: Optional[subprocess.Popen] = None
+    # Simulator admin port (socket transport only) — lets a test open a raw
+    # side-channel to steer chaos when the primary backend's circuit is open.
+    port: Optional[int] = None
+
+    def sim_op(self, op: str, **kwargs) -> Dict:
+        """Route a simulator control op (fault injection, stats) through
+        whichever transport this stack uses."""
+        if self.sim is not None:
+            return self.sim.handle({"op": op, **kwargs})
+        return self.backend.request(op, **kwargs)
 
 
 @dataclass
@@ -92,9 +110,33 @@ class StormReport:
         return not self.problems
 
 
+def spawn_simulator(polls_to_finish: int = 2,
+                    extra_args: Optional[List[str]] = None
+                    ) -> "tuple[subprocess.Popen, int]":
+    """Launch the broker simulator as a real child process in TCP mode and
+    return (proc, bound_port) once its listening banner arrives."""
+    cmd = [sys.executable, "-m",
+           "cruise_control_tpu.executor.broker_simulator",
+           "--listen", "0", "--polls-to-finish", str(polls_to_finish)]
+    cmd += list(extra_args or ())
+    proc = subprocess.Popen(cmd, stdin=subprocess.DEVNULL,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    banner = proc.stdout.readline()
+    try:
+        port = int(json.loads(banner)["listening"])
+    except (ValueError, KeyError, TypeError):
+        proc.kill()
+        raise RuntimeError(
+            f"simulator failed to start (banner: {banner!r})") from None
+    return proc, port
+
+
 def build_storm_stack(scenario: Scenario, num_brokers: int = 6,
                       partitions: int = 16, rf: int = 2,
-                      polls_to_finish: int = 2) -> StormStack:
+                      polls_to_finish: int = 2,
+                      transport: str = "inprocess",
+                      chaos: Optional[Dict] = None) -> StormStack:
     """A small live stack seeded from the scenario: the storm fuzzes the
     control loop, not the solver, so its topology stays executor-sized
     while the scenario's seed decides leader/replica spread."""
@@ -116,13 +158,49 @@ def build_storm_stack(scenario: Scenario, num_brokers: int = 6,
                                    sampling_interval_ms=_W)
     runner.bootstrap(0, 6 * _W)
 
-    sim = BrokerSimulator(polls_to_finish=polls_to_finish)
-    backend = InProcessSimBackend(sim)
+    proc = None
+    if transport == "socket":
+        # Real process boundary + real socket framing: transport faults
+        # (chaos resets/drops, a killed child) hit the reconnecting wrapper
+        # exactly as they would in production.
+        from cruise_control_tpu.executor.subprocess_backend import (
+            SocketClusterBackend,
+        )
+        from cruise_control_tpu.resilience import (
+            CircuitBreaker,
+            ReconnectingBackend,
+            RetryPolicy,
+        )
+        proc, sim_port = spawn_simulator(polls_to_finish=polls_to_finish)
+
+        def factory():
+            # proc stays None on the transport: poisoning a connection must
+            # drop the socket, not kill the shared simulator child.
+            return SocketClusterBackend("127.0.0.1", sim_port,
+                                        request_timeout_s=2.0)
+
+        backend = ReconnectingBackend(
+            factory,
+            policy=RetryPolicy(max_attempts=6, base_delay_s=0.02,
+                               max_delay_s=0.2, deadline_s=15.0),
+            circuit=CircuitBreaker("storm-backend", failure_threshold=8,
+                                   reset_timeout_s=0.2),
+            name="storm-backend")
+        sim = None
+        port = sim_port
+    elif transport == "inprocess":
+        sim = BrokerSimulator(polls_to_finish=polls_to_finish)
+        backend = InProcessSimBackend(sim)
+        port = None
+    else:
+        raise ValueError(f"unknown storm transport {transport!r}")
     backend.request("bootstrap", partitions=[
         {"topic": p.topic, "partition": p.partition,
          "replicas": list(p.replicas), "leader": p.leader,
          "logdirs": {str(b): 0 for b in p.replicas}}
         for p in parts])
+    if chaos:
+        backend.request("chaos", **chaos)
 
     ex = Executor(backend, ExecutorConfig(
         progress_check_interval_s=0.001,
@@ -136,7 +214,8 @@ def build_storm_stack(scenario: Scenario, num_brokers: int = 6,
                        self_healing_goals=list(scenario.goal_names),
                        anomaly_detection_interval_s=3600.0)
     return StormStack(cc=cc, metadata=metadata, sim=sim, backend=backend,
-                      num_brokers=num_brokers)
+                      num_brokers=num_brokers, transport=transport,
+                      proc=proc, port=port)
 
 
 def default_storm_events(scenario: Scenario, cycles: int) -> List[StormEvent]:
@@ -167,11 +246,11 @@ def _inject(stack: StormStack, ev: StormEvent) -> bool:
     if ev.kind == "fail_broker":
         stack.metadata.kill_broker(b)
     elif ev.kind == "fail_disk":
-        stack.sim.handle({"op": "fail_logdir", "broker": b, "logdir": ev.disk})
+        stack.sim_op("fail_logdir", broker=b, logdir=ev.disk)
     elif ev.kind == "stuck_broker":
         # The sim-side failure only: movements touching b retro-stick, so the
         # executor's task-alert timeout (not an exception) must resolve them.
-        stack.sim.handle({"op": "fail_broker", "broker": b})
+        stack.sim_op("fail_broker", broker=b)
     elif ev.kind == "maintenance":
         det = stack.cc.anomaly_detector.detectors[AnomalyType.MAINTENANCE_EVENT]
         det.submit(MaintenanceEvent(plan=ev.plan or "remove_broker",
@@ -216,6 +295,7 @@ def run_storm(scenario: Scenario, cycles: int = 1,
               idle_timeout_s: float = 60.0,
               stack: Optional[StormStack] = None) -> StormReport:
     """Run ``cycles`` inject→detect→heal→converge rounds and audit the ring."""
+    owns_stack = stack is None
     stack = stack or build_storm_stack(scenario)
     report = StormReport(scenario=scenario.name)
     events = scenario.events or default_storm_events(scenario, cycles)
@@ -241,7 +321,7 @@ def run_storm(scenario: Scenario, cycles: int = 1,
             # Heal the sim-side stuck brokers so later cycles can move again
             # (the reference operator restarting a wedged broker).
             for b in stuck:
-                stack.sim.handle({"op": "restore_broker", "broker": b})
+                stack.sim_op("restore_broker", broker=b)
             stuck.clear()
             # Mirror the executed assignment back into the monitor's
             # metadata so the next cycle models the post-heal cluster.
@@ -253,6 +333,14 @@ def run_storm(scenario: Scenario, cycles: int = 1,
             report.cycles_run += 1
     finally:
         stack.cc.anomaly_detector.shutdown()
+        if owns_stack and stack.transport == "socket":
+            try:
+                stack.backend.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            if stack.proc is not None:
+                stack.proc.kill()
+                stack.proc.wait(timeout=5)
     report.audit = audit_log().entries()
     report.problems.extend(audit_coherence(report.audit))
     for e in report.audit:

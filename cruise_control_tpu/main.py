@@ -68,6 +68,8 @@ def build_app(config: CruiseControlConfig,
     # bounds / profile dir before any request or daemon can create spans.
     from cruise_control_tpu.obsvc import configure as configure_obsvc
     configure_obsvc(config)
+    from cruise_control_tpu import resilience
+    res = resilience.configure(config)
     # Materialize the Fuzz.* counters at boot: nightly fuzz campaigns share
     # this registry, and the sensor-drift guard (scripts/check_sensors.py)
     # requires every documented sensor to exist on a live scrape.
@@ -218,13 +220,33 @@ def build_app(config: CruiseControlConfig,
         admin_secret = (read_secret_file(admin_secret_file, "admin backend "
                                          "secret") if admin_secret_file
                         else None)
-        admin_backend = SocketClusterBackend(
-            host or "127.0.0.1", int(aport), auth_secret=admin_secret,
-            ssl_enable=config["executor.admin.backend.ssl.enable"],
-            ssl_cafile=config["executor.admin.backend.ssl.cafile"] or None)
+        ahost = host or "127.0.0.1"
+        aport_i = int(aport)
+        ssl_en = config["executor.admin.backend.ssl.enable"]
+        cafile = config["executor.admin.backend.ssl.cafile"] or None
+
+        def _admin_factory():
+            return SocketClusterBackend(
+                ahost, aport_i, auth_secret=admin_secret,
+                ssl_enable=ssl_en, ssl_cafile=cafile)
+
+        if res.reconnect_enabled:
+            # Transport hiccups rebuild the connection under the retry
+            # policy instead of poisoning the whole execution; the breaker
+            # is published so /metrics and /health can read its state.
+            from cruise_control_tpu.resilience import ReconnectingBackend
+            circuit = res.circuit("backend")
+            resilience.set_backend_circuit(circuit)
+            admin_backend = ReconnectingBackend(
+                _admin_factory, policy=res.retry_policy(), circuit=circuit)
+        else:
+            admin_backend = _admin_factory()
     else:
         admin_backend = FakeClusterBackend(backend)
     executor = Executor(admin_backend, config.executor_config())
+    if res.journal_path:
+        from cruise_control_tpu.executor.journal import ExecutionJournal
+        executor.set_journal(ExecutionJournal(res.journal_path))
     notifier_kwargs = dict(
         self_healing_enabled=config["self.healing.enabled"],
         broker_failure_alert_threshold_ms=

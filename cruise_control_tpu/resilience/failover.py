@@ -1,0 +1,61 @@
+"""Solver device-failover: classify device-loss errors, rerun on CPU.
+
+A dead/hung TPU device surfaces as ``XlaRuntimeError`` (or a wrapped
+``RuntimeError`` with a PJRT status message) at the dispatch seam.  Losing
+the accelerator should degrade the propose path, not kill it: the facade
+catches these, re-runs the solve pinned to the CPU backend, and tags the
+response + trace span ``degraded=true`` so operators can see the cluster is
+being balanced on the slow path.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+LOG = logging.getLogger(__name__)
+
+SOLVER_FAILOVER_SENSOR = "Resilience.solver-cpu-failovers"
+
+#: Exception type names that indicate the runtime/device died (matched by
+#: name — jaxlib's exception classes move between modules across versions).
+_FAILURE_TYPE_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "DeviceLostError",
+    "PjRtError", "InternalError",
+})
+
+#: Status-message markers from PJRT/XLA for device loss and runtime death
+#: (seen in practice over flaky TPU tunnels; see docs/OPERATIONS.md).
+_FAILURE_MARKERS = (
+    "DEVICE_LOST", "device lost", "DATA_LOSS",
+    "failed to enqueue", "Unable to launch",
+    "Socket closed", "Connection reset",
+    "TPU initialization failed", "backend_compile_and_load",
+    "ABORTED: ", "UNAVAILABLE: ",
+)
+
+
+def is_device_failure(exc: BaseException) -> bool:
+    """True when ``exc`` (or anything in its cause chain) looks like the
+    accelerator runtime died, as opposed to an application error."""
+    seen = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if type(cur).__name__ in _FAILURE_TYPE_NAMES:
+            return True
+        if isinstance(cur, (RuntimeError, OSError)):
+            msg = str(cur)
+            if any(marker in msg for marker in _FAILURE_MARKERS):
+                return True
+        cur = cur.__cause__ or cur.__context__
+    return False
+
+
+@contextmanager
+def cpu_fallback():
+    """Run the body with JAX dispatch pinned to the first CPU device."""
+    import jax
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        yield cpu

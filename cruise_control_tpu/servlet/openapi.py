@@ -60,6 +60,10 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
     "trace": ("Recent root span trees (per-request / precompute / executor "
               "batch) and the per-phase time rollup; empty unless "
               "trace.enabled", [], "VIEWER"),
+    "health": ("Component health probes (model freshness, admin backend "
+               "circuit, accelerator liveness, crash-journal lag) with a "
+               "ready/degraded/unhealthy rollup; 503 + Retry-After while "
+               "unhealthy", [], "VIEWER"),
     "profile": ("Capture a JAX device+host profile for duration_s seconds "
                 "and write a TensorBoard trace directory", [
         ("duration_s", "number", "capture window seconds (default 2, "
@@ -172,6 +176,10 @@ def build_spec() -> Dict:
                     "content": {"application/json": {"schema":
                                 {"$ref": "#/components/schemas/Error"}}}},
         }
+        if endpoint == "health":
+            responses["503"] = {
+                "description": "service unhealthy; Retry-After header set",
+                "content": {"application/json": {"schema": ref}}}
         if method == "post" or endpoint in ("proposals",):
             # Long-running operations return 202 + User-Task-ID until done
             # (async servlet machinery; poll with the same header).

@@ -310,6 +310,31 @@ PROFILE_SCHEMA = {
     },
 }
 
+_HEALTH_PROBE_SCHEMA = {
+    "type": "object",
+    "required": ["status"],
+    "properties": {
+        "status": {"type": "string",
+                   "enum": ["ready", "degraded", "unhealthy"]},
+        "reason": {"type": "string"},
+    },
+}
+
+HEALTH_SCHEMA = {
+    "type": "object",
+    "required": ["status", "probes"],
+    "properties": {
+        "status": {"type": "string",
+                   "enum": ["ready", "degraded", "unhealthy"]},
+        "probes": {
+            "type": "object",
+            "required": ["model", "backend", "device", "journal"],
+            "properties": {k: _HEALTH_PROBE_SCHEMA
+                           for k in ("model", "backend", "device", "journal")},
+        },
+    },
+}
+
 ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "state": STATE_SCHEMA,
     "load": LOAD_SCHEMA,
@@ -335,4 +360,5 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "compile_cache": COMPILE_CACHE_SCHEMA,
     "trace": TRACE_SCHEMA,
     "profile": PROFILE_SCHEMA,
+    "health": HEALTH_SCHEMA,
 }

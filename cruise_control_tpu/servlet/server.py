@@ -48,7 +48,7 @@ REQUEST_ID_HEADER = "X-Request-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "compile_cache", "trace"}
+                 "metrics", "compile_cache", "trace", "health"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -56,6 +56,14 @@ POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
 # POSTs subject to two-step verification (mutating cluster state).
 REVIEWABLE = {"add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
               "demote_broker", "topic_configuration"}
+# Endpoints that generate/execute proposals: refused with 503 + Retry-After
+# while /health reports unhealthy (degraded still serves — a CPU-fallback
+# solve or a stale model is slow/conservative, not wrong).  Reads and the
+# stop/pause controls always pass: an operator must be able to stop an
+# execution precisely when things are on fire.
+PROPOSE_ENDPOINTS = {"proposals", "rebalance", "add_broker", "remove_broker",
+                     "demote_broker", "fix_offline_replicas",
+                     "topic_configuration"}
 
 
 def _parse_params(query: str) -> Dict[str, str]:
@@ -201,6 +209,14 @@ class CruiseControlApp:
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 404, {"error": f"unknown POST endpoint {endpoint}"}, {}
 
+        # Degraded-mode admission: while the service is unhealthy, proposing
+        # new work would either fail (backend down) or act on a broken view
+        # of the cluster — shed it up front instead of queueing doomed tasks.
+        if endpoint in PROPOSE_ENDPOINTS:
+            rejected = self._admission_check()
+            if rejected is not None:
+                return rejected
+
         # Two-step verification: park reviewable POSTs without approval.
         if (method == "POST" and self.purgatory is not None
                 and endpoint in REVIEWABLE):
@@ -233,7 +249,42 @@ class CruiseControlApp:
             ).update_ms((_time.monotonic() - t0) * 1000.0)
         return status, body, headers
 
+    def _admission_check(self) -> Optional[Tuple[int, Dict, Dict[str, str]]]:
+        """503 + Retry-After for propose traffic while unhealthy, else None.
+        A broken probe must never turn into a request failure — admission
+        fails open."""
+        from cruise_control_tpu import resilience
+        try:
+            health = self.cc.health()
+        except Exception:  # noqa: BLE001 — probes must not break admission
+            LOG.exception("health probe failed during admission; admitting")
+            return None
+        if health.get("status") != "unhealthy":
+            return None
+        from cruise_control_tpu.common.metrics import registry
+        registry().counter(resilience.ADMISSION_REJECTIONS_SENSOR).inc()
+        retry_after = resilience.settings().health_retry_after_s
+        unhealthy = sorted(name for name, p in health["probes"].items()
+                           if p["status"] == "unhealthy")
+        return 503, {
+            "error": "ServiceUnhealthy",
+            "message": ("service unhealthy "
+                        f"({', '.join(unhealthy) or 'unknown'}); "
+                        "proposal traffic is shed until it recovers"),
+            "health": health,
+        }, {"Retry-After": str(retry_after)}
+
     # ---- sync GETs
+
+    def _ep_health(self, params, task_id):
+        """Component probes + rollup; 503 while unhealthy so plain HTTP
+        checks (load balancers, k8s) need no body parsing."""
+        body = self.cc.health()
+        if body["status"] == "unhealthy":
+            from cruise_control_tpu import resilience
+            return 503, body, {
+                "Retry-After": str(resilience.settings().health_retry_after_s)}
+        return 200, body, {}
 
     def _ep_state(self, params, task_id):
         body = self.cc.state()

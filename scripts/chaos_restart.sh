@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# Crash-recovery drill: start an execution against a live (out-of-process)
+# broker simulator, kill -9 the executor process mid-flight, restart, and
+# assert the write-ahead journal reconciles every task — re-adopted tasks
+# drain to completion, never-submitted tasks roll back, nothing is lost —
+# with the health view going degraded (journal lag) -> ready.
+#
+# Usage:   ./scripts/chaos_restart.sh
+# Exit 0 + "PASS" when the drill holds; nonzero with context otherwise.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+WORK="$(mktemp -d)"
+JOURNAL="$WORK/executor-journal.jsonl"
+SIM_OUT="$WORK/sim.out"
+
+cleanup() {
+  [[ -n "${SIM_PID:-}" ]] && kill -9 "$SIM_PID" 2>/dev/null || true
+  rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+# --- live admin peer: out-of-process simulator on an ephemeral port -------
+python -m cruise_control_tpu.executor.broker_simulator \
+  --listen 0 --polls-to-finish 3 >"$SIM_OUT" &
+SIM_PID=$!
+for _ in $(seq 50); do
+  grep -q listening "$SIM_OUT" 2>/dev/null && break
+  sleep 0.1
+done
+PORT="$(python -c "import json,sys; print(json.load(open('$SIM_OUT'))['listening'])")"
+echo "simulator up on :$PORT (pid $SIM_PID)"
+
+# --- phase 1: journal a batch, get tasks in flight, kill -9 ourselves -----
+# The SIGKILL is the point: no atexit, no finally, no end_batch record —
+# exactly what a crashed or OOM-killed executor leaves behind.
+set +e
+JOURNAL="$JOURNAL" PORT="$PORT" python - <<'EOF'
+import os, signal, time
+
+from cruise_control_tpu.common.actions import (ExecutionProposal,
+                                               ReplicaPlacementInfo,
+                                               TopicPartition)
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.journal import ExecutionJournal
+from cruise_control_tpu.executor.subprocess_backend import SocketClusterBackend
+
+backend = SocketClusterBackend("127.0.0.1", int(os.environ["PORT"]),
+                               request_timeout_s=5.0)
+backend.request("bootstrap", partitions=[
+    {"topic": "T", "partition": p, "replicas": [0, 1], "leader": 0,
+     "logdirs": {"0": 0, "1": 0}} for p in range(4)])
+
+ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+ex.set_journal(ExecutionJournal(os.environ["JOURNAL"]))
+
+
+def proposal(p):
+    return ExecutionProposal(
+        topic_partition=TopicPartition("T", p), partition_size=100.0,
+        old_leader=ReplicaPlacementInfo(0),
+        old_replicas=(ReplicaPlacementInfo(0), ReplicaPlacementInfo(1)),
+        new_replicas=(ReplicaPlacementInfo(2), ReplicaPlacementInfo(1)))
+
+
+ex.execute_proposals([proposal(p) for p in range(4)], wait=False)
+deadline = time.monotonic() + 10.0
+while not backend.in_progress_reassignments():
+    if time.monotonic() > deadline:
+        raise SystemExit("tasks never reached the cluster")
+    time.sleep(0.01)
+print("phase 1: batch journaled, tasks in flight -- kill -9", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+EOF
+RC=$?
+set -e
+if [[ "$RC" -ne 137 && "$RC" -ne 9 ]]; then
+  echo "FAIL: phase 1 exited rc=$RC, expected SIGKILL (137)" >&2
+  exit 1
+fi
+if [[ ! -s "$JOURNAL" ]]; then
+  echo "FAIL: no journal left behind at $JOURNAL" >&2
+  exit 1
+fi
+
+# --- phase 2: restart, reconcile, drain, assert nothing was lost ----------
+JOURNAL="$JOURNAL" PORT="$PORT" python - <<'EOF'
+import json, os, time
+
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.journal import ExecutionJournal
+from cruise_control_tpu.executor.subprocess_backend import SocketClusterBackend
+
+path = os.environ["JOURNAL"]
+journal = ExecutionJournal(path)
+lag = journal.lag()
+assert lag > 0, "restart should see journal lag (health: degraded)"
+print(f"phase 2: journal lag {lag} -> health degraded; reconciling")
+
+backend = SocketClusterBackend("127.0.0.1", int(os.environ["PORT"]),
+                               request_timeout_s=5.0)
+ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.01))
+ex.set_journal(journal)
+summary = ex.recover_from_journal(adoption_timeout_s=30.0)
+print("recovery:", json.dumps(summary, sort_keys=True))
+
+assert summary["status"] == "reconciled", summary
+accounted = (summary["reAdopted"] + summary["completed"]
+             + summary["rolledBack"] + summary["stillInFlight"])
+assert accounted == summary["journaledTasks"], summary
+assert summary["stillInFlight"] == 0, summary
+assert not os.path.exists(path), "journal should be retired after reconcile"
+assert ExecutionJournal(path).lag() == 0, "health: ready"
+assert backend.in_progress_reassignments() == set(), "cluster fully drained"
+print("phase 2: every journaled task re-adopted/completed/rolled back; "
+      "health degraded -> ready")
+backend.close()
+EOF
+
+echo PASS

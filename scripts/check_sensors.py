@@ -133,7 +133,7 @@ def main() -> int:
               f"{len(undocumented)} undocumented — update docs/SENSORS.md",
               file=sys.stderr)
         return 1
-    print(f"OK: {len(live)} live sensors covered by "
+    print(f"OK: {len(snap)} live sensors covered by "
           f"{len(documented)} documented rows")
     return 0
 

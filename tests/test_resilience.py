@@ -1,0 +1,715 @@
+"""Resilience layer tests: retry budgets, circuit breaker, reconnecting
+admin backend, crash-safe execution journal + startup reconciliation,
+backend-down executor pause, solver device-failover, and /health
+degraded-mode serving.
+
+Everything here is transport/state-machine level — no solves, no XLA — so
+the whole module rides the tier-1 budget.  The storm-with-fault-injection
+soak lives at the bottom behind ``@pytest.mark.slow``.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu import resilience
+from cruise_control_tpu.common.metrics import registry
+from cruise_control_tpu.executor.backend import FakeClusterBackend
+from cruise_control_tpu.executor.broker_simulator import BrokerSimulator
+from cruise_control_tpu.executor.executor import (
+    Executor,
+    ExecutorConfig,
+    ExecutorState,
+)
+from cruise_control_tpu.executor.journal import ExecutionJournal
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.subprocess_backend import (
+    BackendCircuitOpenError,
+    BackendTransportError,
+    SocketClusterBackend,
+)
+from cruise_control_tpu.executor.tasks import ExecutionTaskState
+from cruise_control_tpu.resilience.circuit import CircuitBreaker, CircuitState
+from cruise_control_tpu.resilience.failover import is_device_failure
+from cruise_control_tpu.resilience.reconnect import ReconnectingBackend
+from cruise_control_tpu.resilience.retry import (
+    RetryBudgetExhausted,
+    RetryPolicy,
+    call_with_retry,
+)
+from tests.test_executor import _metadata, proposal
+
+
+class _FixedRng:
+    def random(self):
+        return 0.5  # jitter factor exactly 1.0
+
+
+# ------------------------------------------------------------------ retry
+
+
+def test_retry_backoff_sequence_and_success():
+    sleeps = []
+    clock = [0.0]
+
+    def sleep(s):
+        sleeps.append(s)
+        clock[0] += s
+
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 4:
+            raise BackendTransportError("flap")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, max_delay_s=5.0,
+                         multiplier=2.0, jitter=0.5, deadline_s=30.0)
+    out = call_with_retry(fn, policy, retry_on=(BackendTransportError,),
+                          name="t", rng=_FixedRng(),
+                          clock=lambda: clock[0], sleep=sleep)
+    assert out == "ok" and calls[0] == 4
+    assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+
+def test_retry_budget_exhausted_carries_cause():
+    def fn():
+        raise BackendTransportError("always")
+
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.0, deadline_s=30.0)
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        call_with_retry(fn, policy, retry_on=(BackendTransportError,),
+                        name="t", rng=_FixedRng(),
+                        clock=lambda: 0.0, sleep=lambda s: None)
+    assert isinstance(ei.value.__cause__, BackendTransportError)
+
+
+def test_retry_deadline_cuts_attempts_short():
+    clock = [0.0]
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        clock[0] += 20.0  # each attempt burns most of the deadline
+        raise BackendTransportError("slow flap")
+
+    policy = RetryPolicy(max_attempts=10, base_delay_s=1.0, deadline_s=30.0)
+    with pytest.raises(RetryBudgetExhausted):
+        call_with_retry(fn, policy, retry_on=(BackendTransportError,),
+                        name="t", rng=_FixedRng(),
+                        clock=lambda: clock[0], sleep=lambda s: None)
+    assert calls[0] < 10
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise ValueError("not a transport problem")
+
+    with pytest.raises(ValueError):
+        call_with_retry(fn, RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                        retry_on=(BackendTransportError,), name="t",
+                        rng=_FixedRng(), sleep=lambda s: None)
+    assert calls[0] == 1
+
+
+# ---------------------------------------------------------------- circuit
+
+
+def test_circuit_closed_open_half_open_reclose():
+    clock = [0.0]
+    cb = CircuitBreaker("t", failure_threshold=2, reset_timeout_s=10.0,
+                        clock=lambda: clock[0])
+    assert cb.state is CircuitState.CLOSED and cb.allow()
+    cb.record_failure()
+    assert cb.state is CircuitState.CLOSED
+    cb.record_failure()
+    assert cb.state is CircuitState.OPEN and cb.state_value() == 2
+    assert not cb.allow()
+    clock[0] = 10.0
+    assert cb.allow()                      # half-open probe granted
+    assert cb.state is CircuitState.HALF_OPEN
+    assert not cb.allow()                  # probe budget is 1
+    cb.record_success()
+    assert cb.state is CircuitState.CLOSED and cb.reclose_count == 1
+    assert cb.allow()
+
+
+def test_circuit_half_open_failure_reopens():
+    clock = [0.0]
+    cb = CircuitBreaker("t", failure_threshold=1, reset_timeout_s=5.0,
+                        clock=lambda: clock[0])
+    cb.record_failure()
+    assert cb.state is CircuitState.OPEN
+    clock[0] = 5.0
+    assert cb.allow()
+    cb.record_failure()                    # the probe itself failed
+    assert cb.state is CircuitState.OPEN and cb.open_count == 2
+    clock[0] = 6.0
+    assert not cb.allow()                  # timeout restarted
+
+
+def test_circuit_success_resets_failure_streak():
+    cb = CircuitBreaker("t", failure_threshold=3)
+    cb.record_failure()
+    cb.record_failure()
+    cb.record_success()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state is CircuitState.CLOSED
+
+
+# ----------------------------------------------------- reconnecting backend
+
+
+class _FakeInner:
+    """Minimal transport double with the poison/in-progress surface."""
+
+    def __init__(self, fail_times=0):
+        self.fail_times = fail_times
+        self.poisoned = None
+        self.calls = 0
+
+    def in_progress_reassignments(self):
+        return {("T", 1)}
+
+    def describe_topics(self):
+        self.calls += 1
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise BackendTransportError("mid-call death")
+        return [{"topic": "T"}]
+
+    def _poison(self, why):
+        self.poisoned = why
+
+
+def test_reconnecting_backend_rebuilds_and_repolls():
+    inners = []
+
+    def factory():
+        inners.append(_FakeInner(fail_times=1 if not inners else 0))
+        return inners[-1]
+
+    rb = ReconnectingBackend(
+        factory, policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        name="t")
+    assert rb.inner_backend() is None      # lazy: no connect at construction
+    assert rb.describe_topics() == [{"topic": "T"}]
+    # First inner died mid-call, was poisoned+discarded, second succeeded.
+    assert len(inners) == 2
+    assert inners[0].poisoned is not None
+    assert rb.inner_backend() is inners[1]
+    # Every (re)connect re-anchors on the cluster's in-flight work.
+    assert rb.last_repoll == {("T", 1)}
+
+
+def test_reconnecting_backend_circuit_opens_and_probe_recovers():
+    clock = [0.0]
+    down = [True]
+
+    def factory():
+        if down[0]:
+            raise ConnectionError("peer down")
+        return _FakeInner()
+
+    cb = CircuitBreaker("t", failure_threshold=2, reset_timeout_s=5.0,
+                        clock=lambda: clock[0])
+    rb = ReconnectingBackend(
+        factory, policy=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+        circuit=cb, name="t")
+    with pytest.raises(BackendTransportError):
+        rb.describe_topics()
+    assert cb.state is CircuitState.OPEN
+    # Fast-fail while open: the typed error lets the executor pause.
+    with pytest.raises(BackendCircuitOpenError):
+        rb.describe_topics()
+    assert not rb.probe()                  # circuit still holding the door
+    clock[0] = 5.0
+    down[0] = False
+    assert rb.probe()                      # half-open probe succeeds
+    assert cb.state is CircuitState.CLOSED
+    assert rb.describe_topics() == [{"topic": "T"}]
+
+
+# ---------------------------------------------------------------- journal
+
+
+def _tasks(n=3):
+    planner = ExecutionTaskPlanner()
+    return list(planner.add_proposals(
+        [proposal("T", p, [0, 1], [2, 1]) for p in range(n)]))
+
+
+def test_journal_crash_replay_and_torn_line(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = ExecutionJournal(path)
+    t0, t1, t2 = _tasks(3)
+    j.begin_batch([t0, t1, t2])
+    j.record_transition(t0, ExecutionTaskState.IN_PROGRESS)
+    j.record_transition(t0, ExecutionTaskState.COMPLETED)
+    j.record_transition(t1, ExecutionTaskState.IN_PROGRESS)
+    # Simulated kill -9: no end_batch, and a torn half-record at the tail.
+    j.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event": "transi')
+    replay = ExecutionJournal(path).replay()
+    assert replay is not None and not replay.complete
+    assert len(replay.tasks) == 3
+    states = {t.execution_id: t.last_state for t in replay.tasks.values()}
+    assert states[t0.execution_id] == "completed"
+    assert states[t1.execution_id] == "in_progress"
+    assert states[t2.execution_id] == "pending"
+    orphan_ids = {t.execution_id for t in replay.orphans()}
+    assert orphan_ids == {t1.execution_id, t2.execution_id}
+    assert ExecutionJournal(path).lag() == 2
+
+
+def test_journal_clean_batch_has_no_lag(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = ExecutionJournal(path)
+    (t0,) = _tasks(1)
+    j.begin_batch([t0])
+    j.record_transition(t0, ExecutionTaskState.IN_PROGRESS)
+    j.record_transition(t0, ExecutionTaskState.COMPLETED)
+    j.end_batch({"completed": 1, "dead": 0, "aborted": 0})
+    replay = ExecutionJournal(path).replay()
+    assert replay.complete and replay.outcome == {"completed": 1, "dead": 0,
+                                                  "aborted": 0}
+    assert ExecutionJournal(path).lag() == 0
+
+
+def test_journal_written_during_normal_execution(tmp_path):
+    md = _metadata()
+    cluster = FakeClusterBackend(md, polls_to_finish=1)
+    ex = Executor(cluster, ExecutorConfig(progress_check_interval_s=0.001))
+    path = str(tmp_path / "journal.jsonl")
+    ex.set_journal(ExecutionJournal(path))
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+    replay = ExecutionJournal(path).replay()
+    assert replay.complete
+    assert all(t.terminal for t in replay.tasks.values())
+    assert ExecutionJournal(path).lag() == 0
+
+
+def test_executor_recover_from_journal_reconciles(tmp_path):
+    """Crash round-trip: journal written by a 'previous life', reconciled
+    against the live backend — re-adopt / complete / roll back — then the
+    journal is retired and /state surfaces the summary."""
+    md = _metadata()
+    cluster = FakeClusterBackend(md, polls_to_finish=500)
+    path = str(tmp_path / "journal.jsonl")
+
+    # Previous life: accepted 3 tasks, submitted 2, crashed.
+    t0, t1, t2 = _tasks(3)
+    j = ExecutionJournal(path)
+    j.begin_batch([t0, t1, t2])
+    j.record_transition(t0, ExecutionTaskState.IN_PROGRESS)
+    j.record_transition(t1, ExecutionTaskState.IN_PROGRESS)
+    j.close()                              # kill -9 (no end_batch)
+    # t0 is still genuinely moving on the cluster; t1's movement finished
+    # while we were down; t2 never went out.
+    cluster.execute_replica_reassignments([t0])
+
+    ex = Executor(cluster, ExecutorConfig(progress_check_interval_s=0.001))
+    ex.set_journal(ExecutionJournal(path))
+    summary = ex.recover_from_journal(adoption_timeout_s=0.05)
+    assert summary["status"] == "reconciled"
+    assert summary["journaledTasks"] == 3
+    assert summary["rolledBack"] == 1      # t2: accepted, never submitted
+    assert summary["completed"] == 1       # t1: gone from the cluster
+    # t0 is adopted and actively polled, but at 500 polls-to-finish it
+    # cannot drain inside the short adoption window.
+    assert summary["stillInFlight"] == 1
+    assert ex.state_summary()["journalRecovery"]["status"] == "reconciled"
+    assert not os.path.exists(path)        # journal retired after reconcile
+
+
+def test_executor_recovery_keeps_journal_when_backend_down(tmp_path):
+    class _DeadBackend:
+        def in_progress_reassignments(self):
+            raise BackendTransportError("peer down")
+
+    path = str(tmp_path / "journal.jsonl")
+    (t0,) = _tasks(1)
+    j = ExecutionJournal(path)
+    j.begin_batch([t0])
+    j.record_transition(t0, ExecutionTaskState.IN_PROGRESS)
+    j.close()
+    ex = Executor(_DeadBackend(), ExecutorConfig())
+    ex.set_journal(ExecutionJournal(path))
+    summary = ex.recover_from_journal(adoption_timeout_s=0.05)
+    assert summary["status"] == "backend-unavailable"
+    assert os.path.exists(path)            # kept for the next restart
+
+
+# ------------------------------------------------- executor pause / resume
+
+
+class _CircuitFlakyBackend(FakeClusterBackend):
+    """Raises the circuit-open error on every call while ``down`` is set;
+    the probe hook reports recovery once it clears."""
+
+    def __init__(self, metadata):
+        super().__init__(metadata, polls_to_finish=1)
+        self.down = threading.Event()
+        self.probes = 0
+
+    def _gate(self):
+        if self.down.is_set():
+            raise BackendCircuitOpenError("circuit open")
+
+    def execute_replica_reassignments(self, tasks):
+        self._gate()
+        super().execute_replica_reassignments(tasks)
+
+    def finished(self, task):
+        self._gate()
+        return super().finished(task)
+
+    def probe(self):
+        self.probes += 1
+        return not self.down.is_set()
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.002)
+    return True
+
+def _state_count(tracker, state):
+    return sum(by_state.get(state.value, 0)
+               for by_state in tracker.summary().values())
+
+
+def test_executor_pauses_on_open_circuit_and_resumes():
+    md = _metadata()
+    backend = _CircuitFlakyBackend(md)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.001,
+                                          task_execution_alert_timeout_s=0.2))
+    backend.down.set()
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=False)
+    assert _wait_for(lambda: ex.state is ExecutorState.PAUSED_BACKEND_DOWN), \
+        f"never paused (state={ex.state})"
+    # Outage far longer than the alert timeout: the pause must protect the
+    # batch from rotting to DEAD.
+    time.sleep(0.3)
+    backend.down.clear()
+    assert _wait_for(lambda: not ex.has_ongoing_execution)
+    assert backend.probes > 0
+    assert _state_count(ex.tracker, ExecutionTaskState.COMPLETED) == 1
+    assert _state_count(ex.tracker, ExecutionTaskState.DEAD) == 0
+
+
+def test_executor_stop_while_paused_marks_batch_dead():
+    md = _metadata()
+    backend = _CircuitFlakyBackend(md)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.001))
+    backend.down.set()
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=False)
+    assert _wait_for(lambda: ex.state is ExecutorState.PAUSED_BACKEND_DOWN)
+    ex.user_triggered_stop_execution()
+    assert _wait_for(lambda: not ex.has_ongoing_execution)
+    # The popped-but-unsubmitted batch must not leak as forever-PENDING.
+    assert _state_count(ex.tracker, ExecutionTaskState.PENDING) == 0
+
+
+def test_backend_errors_sensor_counts_absorbed_failures():
+    md = _metadata()
+
+    class _FlakyPoll(FakeClusterBackend):
+        def finished(self, task):
+            if not hasattr(self, "_flapped"):
+                self._flapped = True
+                raise BackendTransportError("one-off flap")
+            return super().finished(task)
+
+    backend = _FlakyPoll(md, polls_to_finish=1)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.001))
+    before = registry().counter("Executor.backend-errors").count
+    ex.execute_proposals([proposal("T", 0, [0, 1], [2, 1])], wait=True)
+    assert registry().counter("Executor.backend-errors").count == before + 1
+    assert _state_count(ex.tracker, ExecutionTaskState.COMPLETED) == 1
+
+
+# ------------------------------------------------------- solver failover
+
+
+def test_is_device_failure_classification():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert is_device_failure(XlaRuntimeError("anything"))
+    assert is_device_failure(RuntimeError("DEVICE_LOST: tpu gone"))
+    assert is_device_failure(OSError("Socket closed"))
+    chained = ValueError("wrapper")
+    chained.__cause__ = XlaRuntimeError("inner")
+    assert is_device_failure(chained)
+    assert not is_device_failure(ValueError("plain bad input"))
+    assert not is_device_failure(RuntimeError("ordinary failure"))
+
+
+def test_solver_cpu_failover_tags_degraded():
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack()
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    class _FlakyOptimizer:
+        def __init__(self):
+            self.calls = []
+
+        def optimizations(self, state, placement, meta, options=None,
+                          model_generation=None):
+            self.calls.append(model_generation)
+            if len(self.calls) == 1:
+                raise XlaRuntimeError("DEVICE_LOST: core dumped")
+            return "solved"
+
+    opt = _FlakyOptimizer()
+    before = registry().counter(
+        "Resilience.solver-cpu-failovers").count
+    result, degraded = cc._solve_with_failover(opt, None, None, None, None,
+                                               generation=(1, 1))
+    assert result == "solved" and degraded
+    # The CPU retry must not trust the (possibly poisoned) cache entry.
+    assert opt.calls == [(1, 1), None]
+    assert cc._solver_degraded_at is not None
+    assert registry().counter(
+        "Resilience.solver-cpu-failovers").count == before + 1
+    assert cc.health()["probes"]["device"]["status"] == "degraded"
+    # A clean solve clears the degraded flag.
+    result, degraded = cc._solve_with_failover(opt, None, None, None, None,
+                                               generation=None)
+    assert not degraded and cc._solver_degraded_at is None
+    assert cc.health()["probes"]["device"]["status"] == "ready"
+
+    def boom(*a, **k):
+        raise ValueError("not device-shaped")
+
+    opt.optimizations = boom
+    with pytest.raises(ValueError):
+        cc._solve_with_failover(opt, None, None, None, None, None)
+
+
+# ----------------------------------------------------------------- health
+
+
+def test_health_rollup_and_endpoint():
+    from cruise_control_tpu.servlet.schemas import HEALTH_SCHEMA, validate
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack()
+    body = cc.health()
+    validate(body, HEALTH_SCHEMA)
+    assert body["status"] == "ready"
+    assert set(body["probes"]) == {"model", "backend", "device", "journal"}
+
+    app = CruiseControlApp(cc, port=0)
+    try:
+        status, payload, headers = app.handle("GET", "health", {}, None)
+        assert status == 200 and payload["status"] == "ready"
+
+        # Trip the published backend breaker: rollup goes unhealthy, the
+        # endpoint 503s with Retry-After, and propose traffic is shed while
+        # reads and the stop control still serve.
+        cb = CircuitBreaker("backend", failure_threshold=1)
+        cb.record_failure()
+        resilience.set_backend_circuit(cb)
+        try:
+            assert cc.health()["status"] == "unhealthy"
+            status, payload, headers = app.handle("GET", "health", {}, None)
+            assert status == 503 and "Retry-After" in headers
+            before = registry().counter(
+                "Resilience.admission-rejections").count
+            status, payload, headers = app.handle("POST", "rebalance", {},
+                                                  None)
+            assert status == 503 and "Retry-After" in headers
+            assert payload["error"] == "ServiceUnhealthy"
+            assert registry().counter(
+                "Resilience.admission-rejections").count == before + 1
+            status, _, _ = app.handle("GET", "state", {}, None)
+            assert status == 200
+            status, _, _ = app.handle("POST", "stop_proposal_execution", {},
+                                      None)
+            assert status == 200
+        finally:
+            resilience.set_backend_circuit(None)
+        status, payload, _ = app.handle("GET", "health", {}, None)
+        assert status == 200
+    finally:
+        app.server.server_close()
+        app.user_tasks.shutdown()
+
+
+def test_health_journal_probe_degraded(tmp_path):
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack()
+    path = str(tmp_path / "journal.jsonl")
+    (t0,) = _tasks(1)
+    j = ExecutionJournal(path)
+    j.begin_batch([t0])
+    j.record_transition(t0, ExecutionTaskState.IN_PROGRESS)
+    j.close()                              # crash: orphan left on disk
+    cc.executor.set_journal(ExecutionJournal(path))
+    health = cc.health()
+    assert health["status"] == "degraded"
+    assert health["probes"]["journal"]["status"] == "degraded"
+    assert health["probes"]["journal"]["lag"] == 1
+    cc.executor.recover_from_journal(adoption_timeout_s=0.05)
+    assert cc.health()["probes"]["journal"]["status"] == "ready"
+
+
+def test_health_viewer_role_and_openapi_row():
+    from cruise_control_tpu.servlet.openapi import build_spec
+    from cruise_control_tpu.servlet.security import Role, required_role
+
+    assert required_role("GET", "health") is Role.VIEWER
+    spec = build_spec()
+    assert "/kafkacruisecontrol/health" in spec["paths"]
+    assert "503" in spec["paths"]["/kafkacruisecontrol/health"]["get"][
+        "responses"]
+
+
+# -------------------------------------------------------- simulator chaos
+
+
+def test_simulator_chaos_knobs():
+    sim = BrokerSimulator()
+    assert sim.handle({"op": "chaos", "drop_p": 1.0})["chaos"]["drop_p"] == 1.0
+    assert sim.chaos_action("is_done") == "drop"
+    sim.handle({"op": "chaos", "drop_p": 0.0, "reset_p": 1.0})
+    assert sim.chaos_action("is_done") == "reset"
+    # Control-plane ops are immune so chaos stays steerable.
+    for op in ("chaos", "auth", "shutdown", "bootstrap"):
+        assert sim.chaos_action(op) is None
+    sim.handle({"op": "chaos", "reset_p": 0.0})
+    assert sim.chaos_action("is_done") is None
+    # Seeded: the same seed yields the same decision stream.
+    sim.handle({"op": "chaos", "drop_p": 0.5, "seed": 7})
+    first = [sim.chaos_action("is_done") for _ in range(16)]
+    sim.handle({"op": "chaos", "seed": 7})
+    assert [sim.chaos_action("is_done") for _ in range(16)] == first
+
+
+# -------------------------------------------------- socket e2e reconnect
+
+
+def test_socket_reconnect_after_simulator_kill():
+    """Kill -9 the admin peer mid-session: the reconnecting wrapper rebuilds
+    the transport against the respawned peer and the session keeps going."""
+    from cruise_control_tpu.fuzzsvc.storm import spawn_simulator
+
+    proc, port = spawn_simulator()
+    box = {"port": port}
+
+    def factory():
+        return SocketClusterBackend("127.0.0.1", box["port"],
+                                    request_timeout_s=2.0)
+
+    rb = ReconnectingBackend(
+        factory, policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                    max_delay_s=0.05, deadline_s=10.0),
+        circuit=CircuitBreaker("e2e", failure_threshold=50,
+                               reset_timeout_s=0.05),
+        name="e2e")
+    try:
+        rb.request("bootstrap", partitions=[
+            {"topic": "T", "partition": 0, "replicas": [0, 1], "leader": 0}])
+        assert [p["topic"] for p in rb.describe_topics()] == ["T"]
+        reconnects = registry().counter(
+            "Resilience.backend.reconnects").count
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=5)
+        proc, box["port"] = spawn_simulator()
+
+        # The first call after the kill rides the retry policy through the
+        # dead socket onto the fresh peer (empty state — it's a new sim).
+        assert rb.describe_topics() == []
+        assert registry().counter(
+            "Resilience.backend.reconnects").count > reconnects
+        assert rb.last_repoll == set()
+    finally:
+        rb.close()
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+# ----------------------------------------------------------- chaos storm
+
+
+@pytest.mark.slow
+def test_storm_socket_transport_with_fault_injection():
+    """The acceptance soak: a storm over the REAL socket transport with
+    chaos (latency + drops + resets) armed must converge with a coherent
+    audit ring and no lost tasks, and the circuit must be observed opening
+    and re-closing."""
+    from cruise_control_tpu.fuzzsvc.scenario import generate_scenario
+    from cruise_control_tpu.fuzzsvc.storm import build_storm_stack, run_storm
+
+    sc = generate_scenario(205, kind="dead_disks")
+    stack = build_storm_stack(
+        sc, transport="socket",
+        chaos={"delay_p": 0.2, "delay_ms": 5, "drop_p": 0.03,
+               "reset_p": 0.03, "seed": 7})
+    try:
+        report = run_storm(sc, cycles=3, stack=stack)
+        assert report.ok, report.problems
+        assert report.cycles_run == 3
+        # No lost tasks: every journal... every tracked task reached a
+        # terminal state (the tracker would otherwise still hold it).
+        tracker = stack.cc.executor.tracker
+        assert _state_count(tracker, ExecutionTaskState.PENDING) == 0
+        assert _state_count(tracker, ExecutionTaskState.IN_PROGRESS) == 0
+
+        # Deterministic circuit exercise: full reset storm → open; disarm →
+        # probe until it re-closes.
+        stack.backend.request("chaos", reset_p=1.0, drop_p=0.0,
+                              delay_p=0.0)
+        cb = stack.backend.circuit
+        opened = False
+        for _ in range(20):
+            try:
+                stack.backend.describe_topics()
+            except BackendCircuitOpenError:
+                opened = True
+                break
+            except BackendTransportError:
+                continue  # budget exhausted before the breaker tripped
+        assert opened and cb.open_count > 0
+        # Disarm chaos over a raw side-channel: while reset_p=1.0 the
+        # wrapper's reconnect re-poll gets reset too, so it can never
+        # re-establish on its own — exactly the outage the circuit models.
+        raw = SocketClusterBackend("127.0.0.1", stack.port,
+                                   request_timeout_s=2.0)
+        raw.request("chaos", reset_p=0.0)
+        raw._poison("side-channel done")   # close() would shut the sim down
+        deadline = time.monotonic() + 10.0
+        while cb.state is not CircuitState.CLOSED:
+            assert time.monotonic() < deadline, "circuit never re-closed"
+            stack.backend.probe()
+            time.sleep(0.05)
+        assert cb.reclose_count > 0
+    finally:
+        stack.cc.executor.user_triggered_stop_execution(user=False)
+        try:
+            stack.backend.close()
+        finally:
+            if stack.proc is not None:
+                stack.proc.kill()
+                stack.proc.wait(timeout=5)

@@ -373,6 +373,7 @@ def test_service_assembly_connects_socket_admin_backend():
         SocketClusterBackend,
     )
     from cruise_control_tpu.main import build_app
+    from cruise_control_tpu.resilience import ReconnectingBackend
 
     proc = sp.Popen(
         [sys.executable, "-m",
@@ -389,9 +390,12 @@ def test_service_assembly_connects_socket_admin_backend():
         app = build_app(cfg, port=0)
         try:
             admin = app.cc.executor.backend
-            assert isinstance(admin, SocketClusterBackend)
+            # build_app wraps the socket transport in the reconnecting/
+            # circuit-breaking layer by default.
+            assert isinstance(admin, ReconnectingBackend)
             # The executor's queries cross the real socket.
             assert admin.in_progress_reassignments() == set()
+            assert isinstance(admin.inner_backend(), SocketClusterBackend)
             assert admin.offline_logdirs() == {}
             admin.request("fail_logdir", broker=1, logdir=0)
             assert admin.offline_logdirs() == {1: [0]}
