@@ -1,6 +1,7 @@
 """Benchmark: full multi-goal proposal generation wall-clock.
 
-All five BASELINE.md configs, one JSON line each (headline LAST):
+All five BASELINE.md configs plus the resident-model steady-state config,
+one JSON line each (headline LAST):
 
 - config #1: DeterministicCluster harness — 6 brokers / 3 racks / ~200
   replicas, default goals (the direct comparator for a Java-side
@@ -21,6 +22,18 @@ All five BASELINE.md configs, one JSON line each (headline LAST):
   64-lane batch (cold + warm) even on the CPU fallback — the compilesvc
   lane-chunking planner routes 64 lanes through already-compiled widths,
   so the first 64-lane call should pay (close to) zero fresh compiles.
+- config #6: the resident-model steady state at 2.6K brokers / 1M
+  replicas.  One full freeze seeds the ``ResidentModelService``; each
+  steady round mutates ~64 partitions' loads on the SAME builder and
+  re-proposes through the delta-scatter path (the production facade flow
+  after one LoadMonitor window).  The row carries the full-freeze cost,
+  the mean delta-apply cost, their ratio (``freeze_transfer_reduction``),
+  and the sensor-verified count of full freezes paid during the steady
+  rounds (must be 0).  Two lane rows follow on the SAME resident tensors:
+  the 16-lane decommission batch seeded from the raw snapshot and the
+  identical batch ``warm_start``-ed from the already-solved base
+  placement — the executable is shared (the seed placement is a traced
+  input), so the pair isolates what per-lane early exit buys.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
@@ -35,11 +48,15 @@ satisfied-goal score, [0,100]), plus ``fresh_compiles`` /
 telemetry's compile counter around the timed region — the labels are
 measured, not asserted.
 
-``--trace`` turns on the obsvc span tracer for the run and attaches each
-row's per-phase rollup (``{phase: {count, total_ms, mean_ms}}``, drained
-per row) as a ``trace`` field — per-goal wall plus the solver's fenced
-``device_ms`` attribution ride along, at the cost of a block_until_ready
-fence per goal dispatch, so untraced rows stay the comparable series.
+The obsvc span tracer is ON for every bench run (since r06): each row
+carries ``split_ms`` — the freeze / transfer / delta-apply / solve
+millisecond split from the tracer rollup, drained per row — so the round
+artifact proves where the milliseconds went, not just the total.  Every
+row pays the same per-goal block_until_ready fence, so the series stays
+internally comparable (r05-and-earlier rows were unfenced).  ``--trace``
+additionally attaches the FULL per-phase rollup (``{phase: {count,
+total_ms, mean_ms}}``) as a ``trace`` field — per-goal wall plus the
+solver's fenced ``device_ms`` attribution.
 """
 
 from __future__ import annotations
@@ -106,18 +123,16 @@ def _parse_only(argv):
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
         sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace]  "
-                         "(config numbers 1-5, e.g. --only 3 or "
+                         "(config numbers 1-6, e.g. --only 3 or "
                          "--only 1,5)\n")
         raise SystemExit(2)
 
 
-def _maybe_enable_trace() -> None:
-    """``--trace``: switch the obsvc tracer on for this process so every
-    emitted row carries the per-phase rollup.  Enabled per PROCESS (the TPU
-    child re-enables from its own argv) right before ``run`` so the flag
-    costs nothing when absent."""
-    if "--trace" not in sys.argv:
-        return
+def _enable_trace() -> None:
+    """Switch the obsvc tracer on for this process so every emitted row
+    carries its ``split_ms`` phase attribution (and, under ``--trace``, the
+    full rollup).  Enabled per PROCESS (the TPU child re-enables for
+    itself) right before ``run``."""
     from cruise_control_tpu.obsvc.tracer import tracer
     tracer().configure(enabled=True, ring_size=64)
 
@@ -153,7 +168,7 @@ def main() -> None:
             svc.cache.activate(platform_name="tpu",
                                goal_stack_hash=goal_stack_hash(GOALS))
         try:
-            _maybe_enable_trace()
+            _enable_trace()
             run("tpu", only=only)
         except Exception as e:
             import traceback
@@ -191,13 +206,14 @@ def main() -> None:
             sys.stderr.write("\ntpu child timed out; falling back to cpu\n")
     from cruise_control_tpu.utils.hermetic import force_cpu
     force_cpu()
-    _maybe_enable_trace()
+    _enable_trace()
     run("cpu", only=only)
 
 
-def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
+def _emit(metric: str, seconds: float, backend: str, **extra) -> dict:
     """One JSON line; ``vs_baseline`` is ALWAYS budget/value (whole
-    measurement) so the field stays comparable across metrics and rounds."""
+    measurement) so the field stays comparable across metrics and rounds.
+    Returns the emitted row (config #6 reads its own ``split_ms`` back)."""
     row = {
         "metric": metric,
         "value": round(seconds, 4),
@@ -211,8 +227,27 @@ def _emit(metric: str, seconds: float, backend: str, **extra) -> None:
     if tr.enabled:
         # Drained per row: each row's rollup covers only the phases since
         # the previous row (warmup calls included — honest attribution).
-        row["trace"] = tr.rollup(reset=True)
+        roll = tr.rollup(reset=True)
+        row["split_ms"] = _split_ms(roll)
+        if "--trace" in sys.argv:
+            row["trace"] = roll
     print(json.dumps(row), flush=True)
+    return row
+
+
+def _split_ms(roll: dict) -> dict:
+    """The freeze / transfer / delta-apply / solve millisecond split for one
+    row, from the drained tracer rollup.  ``solve`` is the sequential
+    ``optimize`` span plus the batched ``batch_optimize`` span; rows frozen
+    outside the resident service (rc.generate fixtures) honestly report 0
+    for the model phases."""
+    g = lambda k: roll.get(k, {}).get("total_ms", 0.0)
+    return {
+        "freeze": g("model.freeze"),
+        "transfer": g("model.transfer"),
+        "delta_apply": g("model.delta_apply"),
+        "solve": round(g("optimize") + g("batch_optimize"), 3),
+    }
 
 
 def _compile_fields(fresh: int) -> dict:
@@ -455,6 +490,11 @@ def run(backend: str, only=None) -> None:
                                  "fallback; row skipped\n")
         del h_state, h_placement, opt_hard
 
+    # ---- config #6: resident-model steady state (delta propose) plus the
+    # raw-seed vs warm-started lane pair, at the north-star shape.
+    if want(6):
+        _delta_propose_rows(backend, lanes=64 if backend == "tpu" else 16)
+
     if backend == "cpu":
         _replay_captured_tpu_rows()
 
@@ -462,6 +502,142 @@ def run(backend: str, only=None) -> None:
     if headline is not None:
         _emit("proposal_generation_wall_clock_200brokers_50k_replicas_"
               "full_goals", headline[0], backend, **headline[1])
+
+
+def _delta_propose_rows(backend: str, props=None, lanes: int = 16,
+                        tag: str = "2600brokers_1m_replicas",
+                        mutations: int = 64, rounds: int = 3) -> None:
+    """Config #6 (module docstring): the resident-model steady state.
+
+    One full freeze seeds a ``ResidentModelService`` from a live builder;
+    each steady round mutates ``mutations`` random partitions' loads on
+    that SAME builder and re-proposes through the delta-scatter path — the
+    production facade flow once the LoadMonitor has published a window.
+    The steady row's ``freeze_transfer_reduction`` divides the seed row's
+    measured freeze+transfer milliseconds by the mean delta-apply cost;
+    ``full_freezes_steady_state`` is the sensor-verified count of full
+    freezes paid during the steady rounds (0 ⇔ the delta contract held).
+    """
+    import numpy as np
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.common.metrics import registry
+    from cruise_control_tpu.compilesvc import compile_service
+    from cruise_control_tpu.model.builder import builder_from_snapshot
+    from cruise_control_tpu.model.resident import (
+        DELTA_APPLY_SENSOR,
+        FULL_FREEZE_SENSOR,
+        ResidentModelService,
+    )
+    from cruise_control_tpu.obsvc.tracer import tracer
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    if props is None:
+        props = rc.ClusterProperties(
+            num_brokers=2600, num_racks=40, num_topics=2000,
+            num_replicas=1_000_000, mean_cpu=0.002, mean_disk=60.0,
+            mean_nw_in=60.0, mean_nw_out=60.0, seed=3143)
+    state, placement, meta = rc.generate(props)
+    builder = builder_from_snapshot(state, placement, meta)
+    del state, placement, meta
+
+    svc = ResidentModelService()
+    pad_fn = compile_service().pad_targets
+    reg = registry()
+    full_ctr = reg.counter(FULL_FREEZE_SENSOR)
+    delta_ctr = reg.counter(DELTA_APPLY_SENSOR)
+
+    tracer().rollup(reset=True)   # this config's rows attribute only itself
+    freeze_s, (r_state, r_placement, r_meta), _ = _timed_once(
+        lambda: svc.snapshot(builder, pad_fn))
+    freeze_row = _emit(f"resident_full_freeze_{tag}", freeze_s, backend,
+                       replicas=props.num_replicas,
+                       brokers=props.num_brokers)
+    split = freeze_row.get("split_ms", {})
+    freeze_transfer_ms = round(split.get("freeze", 0.0)
+                               + split.get("transfer", 0.0), 3)
+
+    # Base solve: warms the sequential executables AND produces the solved
+    # base placement the warm-started lanes seed from.  Its (cold) compile
+    # rides the steady row's split under "solve" — honest attribution, same
+    # as every other config's warmup.
+    opt = GoalOptimizer(goal_names=HARD_GOALS)
+    base_res = opt.optimizations(r_state, r_placement, r_meta)
+
+    rng = np.random.default_rng(314159)
+    pairs = list(builder.partitions().keys())
+
+    def mutate() -> None:
+        # Small multiplicative load drift on whole partitions: the shape of
+        # a real inter-window change, and it keeps hard goals satisfiable.
+        for _ in range(mutations):
+            t, p = pairs[int(rng.integers(len(pairs)))]
+            for r in builder.partition(t, p):
+                builder.set_replica_load(
+                    t, p, r.broker_id,
+                    r.leader_load * float(rng.uniform(0.85, 1.2)))
+
+    def propose():
+        s, p, m = svc.snapshot(builder, pad_fn)
+        return opt.optimizations(s, p, m)
+
+    # One untimed warmup round (the _timed convention): it pays the scatter
+    # executable's compile at the steady slot bucket, exactly what the boot
+    # warmup daemon pays in production.
+    mutate()
+    propose()
+
+    full0, delta0 = full_ctr.count, delta_ctr.count
+    da0 = tracer().rollup().get("model.delta_apply",
+                                {"count": 0, "total_ms": 0.0})
+    steady, fresh_total, res = [], 0, base_res
+    for _ in range(rounds):
+        mutate()
+        dt, res, fresh = _timed_once(propose)
+        steady.append(dt)
+        fresh_total += fresh
+    steady_s = sum(steady) / len(steady)
+    full_steady = int(full_ctr.count - full0)
+    if full_steady:
+        sys.stderr.write(f"steady state paid {full_steady} full re-freezes "
+                         "— the delta path did not hold\n")
+    da1 = tracer().rollup().get("model.delta_apply",
+                                {"count": 0, "total_ms": 0.0})
+    da_count = da1["count"] - da0["count"]
+    da_mean = (da1["total_ms"] - da0["total_ms"]) / max(da_count, 1)
+    _emit(f"steady_state_delta_propose_{tag}_hard_goals", steady_s, backend,
+          rounds=rounds, mutations_per_round=mutations,
+          full_freeze_s=round(freeze_s, 4),
+          freeze_transfer_ms=freeze_transfer_ms,
+          delta_apply_ms_mean=round(da_mean, 3),
+          freeze_transfer_reduction=round(
+              freeze_transfer_ms / max(da_mean, 1e-6), 1),
+          full_freezes_steady_state=full_steady,
+          delta_applies=int(delta_ctr.count - delta0),
+          **_quality(res), **_compile_fields(fresh_total))
+
+    # Lane pair on the SAME resident tensors: raw-snapshot seed first, then
+    # the identical batch warm-started from the solved base placement.  The
+    # seed placement is a traced input, so the second batch reuses the
+    # first's executables — the pair isolates per-lane early exit.
+    c_state, c_placement, c_meta = svc.snapshot(builder, pad_fn)
+    base = (res.final_placement if res.final_placement is not None
+            else base_res.final_placement)
+    sets = [[b] for b in range(lanes)]
+    cold_s, cold_res, cold_fresh = _timed_once(
+        lambda: opt.batch_remove_scenarios(
+            c_state, c_placement, c_meta, sets, num_candidates=512))
+    _emit(f"remove_broker_what_ifs_{tag}_hard_goals_resident_base", cold_s,
+          backend, value_per_lane=round(cold_s / lanes, 4), lanes=lanes,
+          warm_start=False, **_batch_quality(cold_res),
+          **_compile_fields(cold_fresh))
+    warm_s, warm_res, warm_fresh = _timed_once(
+        lambda: opt.batch_remove_scenarios(
+            c_state, c_placement, c_meta, sets, num_candidates=512,
+            warm_start=base))
+    _emit(f"remove_broker_what_ifs_{tag}_hard_goals_warm_started", warm_s,
+          backend, value_per_lane=round(warm_s / lanes, 4), lanes=lanes,
+          warm_start=True, **_batch_quality(warm_res),
+          **_compile_fields(warm_fresh))
 
 
 def _replay_captured_tpu_rows() -> None:

@@ -455,6 +455,7 @@ class GoalOptimizer:
         options: Optional[OptimizationOptions] = None,
         goals: Optional[Sequence[Goal]] = None,
         num_candidates: int = 512,
+        warm_start: Optional[Placement] = None,
     ) -> BatchScenarioResult:
         """Solve S independent remove-broker what-ifs as ONE vmapped program
         per goal (BASELINE config #5; SURVEY §7 'jit once, vmap over
@@ -465,10 +466,17 @@ class GoalOptimizer:
         whose liveness/exclusion masks differ, so the entire fleet of what-ifs
         costs one compiled solve per goal.  Scenario-dependent context (host
         capacity) is recomputed inside the trace.
+
+        ``warm_start`` seeds every lane from an already-balanced placement
+        (the facade's last full solve) instead of the raw snapshot: lanes
+        only repair their own scenario's damage, and the while_loop's
+        per-lane progress guard retires converged lanes after their first
+        no-move round while unconverged lanes keep iterating.
         """
         return self._batch_scenarios(state, placement, meta, removal_sets,
                                      revive=False, options=options,
-                                     goals=goals, num_candidates=num_candidates)
+                                     goals=goals, num_candidates=num_candidates,
+                                     warm_start=warm_start)
 
     def batch_add_scenarios(
         self,
@@ -479,6 +487,7 @@ class GoalOptimizer:
         options: Optional[OptimizationOptions] = None,
         goals: Optional[Sequence[Goal]] = None,
         num_candidates: int = 512,
+        warm_start: Optional[Placement] = None,
     ) -> BatchScenarioResult:
         """Add-broker what-ifs as vmapped lanes (the AddBrokersRunnable
         analog of :meth:`batch_remove_scenarios`).
@@ -490,22 +499,43 @@ class GoalOptimizer:
         expansion studies."""
         return self._batch_scenarios(state, placement, meta, addition_sets,
                                      revive=True, options=options,
-                                     goals=goals, num_candidates=num_candidates)
+                                     goals=goals, num_candidates=num_candidates,
+                                     warm_start=warm_start)
 
     def _batch_scenarios(self, state, placement, meta, scenario_sets, revive,
-                         options, goals, num_candidates) -> BatchScenarioResult:
+                         options, goals, num_candidates,
+                         warm_start=None) -> BatchScenarioResult:
+        tr = _obsvc_tracer()
+        if not tr.enabled:
+            return self._batch_scenarios_impl(
+                state, placement, meta, scenario_sets, revive, options, goals,
+                num_candidates, warm_start)
+        with tr.span("batch_optimize", lanes=len(scenario_sets),
+                     warm_start=warm_start is not None):
+            return self._batch_scenarios_impl(
+                state, placement, meta, scenario_sets, revive, options, goals,
+                num_candidates, warm_start)
+
+    def _batch_scenarios_impl(self, state, placement, meta, scenario_sets,
+                              revive, options, goals, num_candidates,
+                              warm_start=None) -> BatchScenarioResult:
         options = options or OptimizationOptions()
         goals = (list(goals) if goals is not None
                  else get_goals_by_priority(self.goal_names))
+        # Context is built from the BASE placement either way: it only feeds
+        # placement-independent statics (capacity, racks, exclusions); every
+        # lane recomputes its aggregates from its own (possibly warm-started)
+        # placement inside the compiled solve.
         gctx = build_context(state, placement, meta, self.constraint, options)
         masks = _scenario_masks(gctx, state, meta, scenario_sets, revive=revive)
         return self._run_mask_scenarios(gctx, state, placement, goals,
-                                        num_candidates, scenario_sets, *masks)
+                                        num_candidates, scenario_sets, *masks,
+                                        warm_start=warm_start)
 
     def _run_mask_scenarios(self, gctx, state, placement, goals,
                             num_candidates, scenario_sets,
-                            alive_s, excl_move_s, excl_lead_s
-                            ) -> BatchScenarioResult:
+                            alive_s, excl_move_s, excl_lead_s,
+                            warm_start=None) -> BatchScenarioResult:
         """Shared lane runner, routed through the compile service's lane-chunk
         plan: an S-lane batch is split into blocks at already-compiled (or
         canonical-bucket) lane widths, so a 64-lane request rides the 16-lane
@@ -535,7 +565,7 @@ class GoalOptimizer:
         if plan is None or plan_is_identity(plan, s_n):
             out = self._run_lane_block(gctx, state, placement, goals,
                                        num_candidates, alive_s, excl_move_s,
-                                       excl_lead_s)
+                                       excl_lead_s, warm_start=warm_start)
             if lane_key is not None:
                 svc.note_lanes_compiled(lane_key, s_n)
             rounds, moves, violated, stranded, placement_s = out
@@ -547,7 +577,8 @@ class GoalOptimizer:
                 idx = np.minimum(chunk.start + np.arange(chunk.size), s_n - 1)
                 out = self._run_lane_block(
                     gctx, state, placement, goals, num_candidates,
-                    alive_s[idx], excl_move_s[idx], excl_lead_s[idx])
+                    alive_s[idx], excl_move_s[idx], excl_lead_s[idx],
+                    warm_start=warm_start)
                 svc.note_lanes_compiled(lane_key, chunk.size)
                 n = chunk.n_real
                 blocks.append(tuple(
@@ -572,9 +603,17 @@ class GoalOptimizer:
         )
 
     def _run_lane_block(self, gctx, state, placement, goals, num_candidates,
-                        alive_s, excl_move_s, excl_lead_s):
+                        alive_s, excl_move_s, excl_lead_s, warm_start=None):
         """One vmapped solve per goal over a block of lanes; returns host-local
-        (rounds[S,G], moves[S,G], violated[S,G], stranded[S], placements)."""
+        (rounds[S,G], moves[S,G], violated[S,G], stranded[S], placements).
+
+        ``warm_start`` replaces the seed placement broadcast into the lanes.
+        The executable is warm-start-agnostic — the placement is a traced
+        input, so a warm block reuses the cold block's compilation.  Early
+        exit is per-lane by construction: the vmapped while_loop's condition
+        (work remaining ∧ progress ∧ round budget) masks each lane
+        independently, so a lane seeded next to its fixed point stops
+        spending candidate evaluations after its first no-move round."""
         import jax
         import jax.numpy as jnp
 
@@ -583,8 +622,10 @@ class GoalOptimizer:
         excl_move_j = jnp.asarray(excl_move_s)
         excl_lead_j = jnp.asarray(excl_lead_s)
 
+        seed = placement if warm_start is None else warm_start
         placement_s = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (s_n,) + x.shape), placement)
+            lambda x: jnp.broadcast_to(jnp.asarray(x)[None],
+                                       (s_n,) + x.shape), seed)
         if self.solver.mesh is not None:
             from cruise_control_tpu.parallel import (
                 replica_shardings,

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Union
 
 from cruise_control_tpu.compilesvc.buckets import ShapeBucketPolicy
 from cruise_control_tpu.compilesvc.cache import PersistentCompileCache
@@ -44,16 +44,29 @@ class CompileService:
                  compile_telemetry: Optional[CompileTelemetry] = None,
                  chunking_enabled: bool = True,
                  warmup_enabled: bool = False,
-                 warmup_lanes: int = 4):
+                 warmup_lanes: Union[int, Sequence[int]] = 4):
         self.policy = policy or ShapeBucketPolicy()
         self.cache = cache or PersistentCompileCache()
         self.telemetry = compile_telemetry or telemetry()
         self.chunking_enabled = bool(chunking_enabled)
         self.warmup_enabled = bool(warmup_enabled)
-        self.warmup_lanes = int(warmup_lanes)
+        # ``warmup_lanes`` is a LADDER: every width in it gets its own warm
+        # what-if task, so chunked wide batches find each block width already
+        # compiled.  A scalar is accepted for back-compat (one-rung ladder).
+        if isinstance(warmup_lanes, (int, float, str)):
+            rungs = [int(warmup_lanes)]
+        else:
+            rungs = [int(w) for w in warmup_lanes]
+        self.warmup_lane_ladder: Tuple[int, ...] = tuple(
+            sorted({max(1, w) for w in rungs})) or (1,)
         self._lock = threading.Lock()
         # (stack_hash, R_padded, B_padded, C) -> lane widths already compiled
         self._compiled_lanes: Dict[Tuple, Set[int]] = {}
+
+    @property
+    def warmup_lanes(self) -> int:
+        """Widest ladder rung — the historical scalar accessor."""
+        return self.warmup_lane_ladder[-1]
 
     # ------------------------------------------------------------- shapes
 
@@ -109,6 +122,7 @@ class CompileService:
             },
             "chunking_enabled": self.chunking_enabled,
             "warmup_enabled": self.warmup_enabled,
+            "warmup_lane_ladder": list(self.warmup_lane_ladder),
             "compiled_lane_widths": lane_registry,
             "persistent_cache": self.cache.stats(),
             "telemetry": self.telemetry.snapshot(),
@@ -133,6 +147,14 @@ def set_compile_service(svc: Optional[CompileService]) -> None:
     global _GLOBAL
     with _GLOBAL_LOCK:
         _GLOBAL = svc
+
+
+def _parse_lanes(raw) -> List[int]:
+    """``compile.warmup.lanes`` ladder: LIST config yields List[str]; legacy
+    scalar ints (and bare "8" strings) still mean a one-rung ladder."""
+    if isinstance(raw, (list, tuple)):
+        return [int(str(w).strip()) for w in raw if str(w).strip()] or [4]
+    return [int(w) for w in str(raw).split(",") if w.strip()] or [4]
 
 
 def configure(config) -> CompileService:
@@ -178,7 +200,7 @@ def configure(config) -> CompileService:
         cache=cache,
         chunking_enabled=bool(_get("compile.lane.chunking.enabled", True)),
         warmup_enabled=bool(_get("compile.warmup.enabled", True)),
-        warmup_lanes=int(_get("compile.warmup.lanes", 4)),
+        warmup_lanes=_parse_lanes(_get("compile.warmup.lanes", [4])),
     )
     set_compile_service(svc)
     return svc

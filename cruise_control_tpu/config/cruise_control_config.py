@@ -223,8 +223,10 @@ def _compile_def() -> ConfigDef:
              doc="start the background warmup daemon on facade start_up; it "
                  "runs real dryrun solves at the canonical shape buckets so "
                  "the first operator request never pays cold-compile latency")
-    d.define("compile.warmup.lanes", ConfigType.INT, 4, range_validator(1),
-             doc="what-if lane width the warmup daemon pre-compiles")
+    d.define("compile.warmup.lanes", ConfigType.LIST, "4",
+             doc="what-if lane-width LADDER the warmup daemon pre-compiles "
+                 "(comma list, e.g. \"4,16\"); every width gets its own warm "
+                 "task so chunked wide batches find each block width hot")
     d.define("compile.lane.chunking.enabled", ConfigType.BOOLEAN, True,
              doc="route wide what-if batches through already-compiled lane "
                  "executables (e.g. 64 lanes as 4x16) instead of compiling "
@@ -258,6 +260,30 @@ def _compile_def() -> ConfigDef:
                  "load probe of the XLA:CPU loader (memoized per jaxlib + "
                  "machine fingerprint); false restores blind-trust "
                  "activation for hosts validated out of band")
+    return d
+
+
+def _model_def() -> ConfigDef:
+    """Resident-model keys (no reference analog — the reference JVM rebuilds
+    its ClusterModel object graph per request; this port keeps the frozen
+    tensors on-device and scatter-applies monitor deltas)."""
+    d = ConfigDef()
+    d.define("model.resident.enabled", ConfigType.BOOLEAN, True,
+             doc="keep the frozen (state, placement) tensors device-resident "
+                 "across requests and apply LoadMonitor changes as sparse "
+                 "scatter deltas; disable to re-freeze the full model every "
+                 "request (the pre-resident behavior)")
+    d.define("model.resident.max.delta.slots", ConfigType.INT, 8192,
+             range_validator(1),
+             doc="largest touched-row count a delta may carry; bigger edits "
+                 "fall back to a full freeze (slots are padded to a "
+                 "geometric bucket so the scatter executable's shape is "
+                 "stable)")
+    d.define("model.resident.max.delta.chain", ConfigType.INT, 512,
+             range_validator(1),
+             doc="consecutive delta applies allowed since the last full "
+                 "freeze; the next snapshot past this re-freezes (bounds "
+                 "numeric drift and caps replay length)")
     return d
 
 
@@ -407,7 +433,8 @@ class CruiseControlConfig:
     def __init__(self, props: Optional[Dict[str, Any]] = None):
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
-                           .merge(_compile_def()).merge(_trace_def())
+                           .merge(_compile_def()).merge(_model_def())
+                           .merge(_trace_def())
                            .merge(_fuzz_def()).merge(_resilience_def())
                            .merge(_webserver_def()))
         props = dict(props or {})

@@ -52,6 +52,7 @@ from cruise_control_tpu.detector.notifier import NoopNotifier, SelfHealingNotifi
 from cruise_control_tpu.executor.executor import (Executor, ExecutorConfig,
                                                   ExecutorState)
 from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.model.resident import ResidentModelService
 from cruise_control_tpu.model.stats import compute_stats
 from cruise_control_tpu.monitor.load_monitor import (
     LoadMonitor,
@@ -106,9 +107,21 @@ class CruiseControl:
         proposal_precompute_interval_s: float = 0.0,
         default_completeness: Optional[ModelCompletenessRequirements] = None,
         topic_anomaly_target_rf: Optional[int] = None,
+        resident_service: Optional[ResidentModelService] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
+        # Device-resident cluster model: frozen tensors stay on-device across
+        # requests and the monitor's changes arrive as scatter-applied deltas
+        # instead of full re-freezes (perf_opt: resident model).
+        self.resident = resident_service or ResidentModelService()
+        # Offline-logdir key of the last resident build: a flip means disk
+        # deaths changed, which the delta journal does not express — rebuild.
+        self._offline_key: Optional[tuple] = None
+        # (model_generation, Placement) of the last default-goal full solve;
+        # seeds what-if lanes so they polish a near-balanced placement
+        # instead of re-deriving it from scratch.
+        self._base_solution: Optional[tuple] = None
         self.task_runner = task_runner
         # Baseline completeness gate for every goal-based operation
         # (min.valid.partition.ratio; requests may pass stricter ones).
@@ -255,10 +268,49 @@ class CruiseControl:
         buckets (geometric over the PAD_R/PAD_B floors), so every snapshot
         of a similar-sized cluster lands on an already-compiled shape."""
         from cruise_control_tpu.compilesvc import compile_service
-        n_replicas = sum(len(rs) for rs in builder.partitions().values())
-        pad_r, pad_b = compile_service().pad_targets(
-            n_replicas, len(builder.brokers()))
+        n_replicas, n_brokers = builder.counts()
+        pad_r, pad_b = compile_service().pad_targets(n_replicas, n_brokers)
         return builder.freeze(pad_replicas_to=pad_r, pad_brokers_to=pad_b)
+
+    def _resident_snapshot(self, requirements=None):
+        """Device tensors for the monitor's current model via the resident
+        service: the monitor diffs its long-lived builder, the service turns
+        the journal into a scatter-applied delta, and only bucket changes /
+        inexpressible edits pay a full freeze.  Returned tensors are PINNED —
+        callers must :meth:`ResidentModelService.release` after the solve."""
+        from cruise_control_tpu.compilesvc import compile_service
+
+        def build() -> ClusterModel:
+            # Runs under the resident service lock, so the monitor diff and
+            # the delta collection cannot interleave with another request.
+            try:
+                offline = self._offline_logdirs() or {}
+            except Exception as e:   # noqa: BLE001 — network seam
+                LOG.warning("offline-logdir query failed (%s); building the "
+                            "model without dead-disk enrichment", e)
+                offline = {}
+            key = tuple(sorted((int(b), tuple(sorted(int(d) for d in ds)))
+                               for b, ds in offline.items()))
+            if key != self._offline_key:
+                # A recovered disk has no mark_disk_alive analog, so any
+                # flip in the offline set forces a rebuild + full freeze
+                # rather than trying to express it as a delta.
+                self.load_monitor.reset_resident_builder()
+                self.resident.invalidate("offline-logdirs-changed")
+                self._offline_key = key
+            builder, fresh = self.load_monitor.resident_model_builder(
+                requirements=requirements)
+            if fresh:
+                for b_id, disks in offline.items():
+                    for d in disks:
+                        try:
+                            builder.mark_disk_dead(int(b_id), int(d))
+                        except (KeyError, IndexError):
+                            pass
+            return builder
+
+        return self.resident.snapshot(build, compile_service().pad_targets,
+                                      pin=True)
 
     def _build_warmup_daemon(self):
         """Warm tasks run REAL solves at the bucket shapes: AOT
@@ -292,23 +344,56 @@ class CruiseControl:
             raise TimeoutError(
                 f"load monitor produced no complete window in {timeout_s:.0f}s")
 
+        def _warm_snapshot():
+            """Bucketed tensors for warm tasks — through the resident service
+            when enabled, so the warmup ALSO seeds the resident entry and the
+            first operator request starts on the delta path."""
+            if self.resident.enabled:
+                return self._resident_snapshot(), True
+            builder = self.load_monitor.cluster_model_builder()
+            return self._freeze_bucketed(builder), False
+
         def warm_proposals():
             wait_model_ready()
             self.proposals()
 
-        def warm_whatif():
+        def _warm_whatif(width: int):
             wait_model_ready()
-            builder = self.load_monitor.cluster_model_builder()
-            state, placement, meta = self._freeze_bucketed(builder)
-            width = max(1, svc.warmup_lanes)
-            first = [int(meta.broker_ids[0])]
-            self.optimizer.batch_remove_scenarios(
-                state, placement, meta, [list(first) for _ in range(width)])
+            (state, placement, meta), pinned = _warm_snapshot()
+            try:
+                first = [int(meta.broker_ids[0])]
+                self.optimizer.batch_remove_scenarios(
+                    state, placement, meta,
+                    [list(first) for _ in range(width)])
+            finally:
+                if pinned:
+                    self.resident.release()
+
+        def warm_delta():
+            # Compile the delta-apply scatter executables at the model's
+            # bucket so the first steady-state delta never pays a trace.
+            wait_model_ready()
+            if not self.resident.enabled:
+                return
+            (state, placement, meta), pinned = _warm_snapshot()
+            try:
+                self.resident.warm_scatter(
+                    int(state.leader_load.shape[0]),
+                    int(state.capacity.shape[0]),
+                    int(state.disk_capacity.shape[1]))
+            finally:
+                if pinned:
+                    self.resident.release()
 
         daemon.add_task(("proposals", tuple(self.default_goals)),
                         warm_proposals)
-        daemon.add_task(("whatif", tuple(self.default_goals),
-                         max(1, svc.warmup_lanes)), warm_whatif)
+        # The lane ladder is a LIST: each width warms its own vmapped
+        # executable, so chunked wide batches find every block width hot.
+        for width in svc.warmup_lane_ladder:
+            w = max(1, int(width))
+            daemon.add_task(("whatif", tuple(self.default_goals), w),
+                            lambda w=w: _warm_whatif(w))
+        daemon.add_task(("warm_delta", tuple(self.default_goals)), warm_delta)
         return daemon
 
     def _offline_logdirs(self):
@@ -417,42 +502,43 @@ class CruiseControl:
                                 self.default_completeness))
         if not dryrun:
             self.executor.set_generating_proposals_for_execution(True)
+        pinned = False
         try:
-            builder = self.load_monitor.cluster_model_builder(
-                requirements=requirements)
-            # Dead logdirs are the ADMIN backend's knowledge (AdminClient
-            # describeLogDirs in the reference), not the metadata sampler's:
-            # fold them into the model so their replicas solve as offline —
-            # without this, fix_offline_replicas would "fix" a healthy model
-            # and never evacuate the failed disk.  Logdir ids map to the
-            # broker's disk indices (the JBOD contract the capacity resolver
-            # uses).  A transient admin-socket failure must not take down
-            # every optimization operation (the query is an enrichment, and
-            # the anomaly cycle retries) — log it and build without.
-            try:
-                offline = self._offline_logdirs() or {}
-            except Exception as e:   # noqa: BLE001 — network seam
-                LOG.warning("offline-logdir query failed (%s); building the "
-                            "model without dead-disk enrichment", e)
-                offline = {}
-            for b_id, disks in offline.items():
-                for d in disks:
-                    try:
-                        builder.mark_disk_dead(int(b_id), int(d))
-                    except (KeyError, IndexError):
-                        # Broker/disk absent from current metadata (e.g.
-                        # already decommissioned) — nothing to mark.
-                        pass
-            if model_mutator is not None:
-                model_mutator(builder)
-            state, placement, meta = self._freeze_bucketed(builder)
+            # Mutator-free operations ride the resident model: on-device
+            # tensors updated by scatter-applied monitor deltas.  Mutators
+            # (add/remove/demote, RF change) edit a THROWAWAY builder, so
+            # they keep the classic build-enrich-freeze path.
+            if model_mutator is None and self.resident.enabled:
+                state, placement, meta = self._resident_snapshot(requirements)
+                pinned = True
+            else:
+                state, placement, meta = self._freeze_bucketed(
+                    self._build_enriched(requirements, model_mutator))
+
+            def refreeze():
+                # The tensors (and the resident entry's buffers) may live on
+                # the failed device; drop everything device-side and rebuild
+                # from the monitor inside the CPU fallback context.
+                self.resident.invalidate("device-failover")
+                self.load_monitor.reset_resident_builder()
+                return self._freeze_bucketed(
+                    self._build_enriched(requirements, model_mutator))
+
             optimizer = (self.optimizer if goals == self.default_goals
                          else GoalOptimizer(constraint=self.constraint,
                                             goal_names=goals))
             generation = (self.load_monitor.model_generation
                           if use_cached and model_mutator is None else None)
             result, degraded = self._solve_with_failover(
-                optimizer, state, placement, meta, options, generation)
+                optimizer, state, placement, meta, options, generation,
+                refreeze=refreeze)
+            if (model_mutator is None and not degraded
+                    and goals == self.default_goals
+                    and result.final_placement is not None):
+                # Remember the balanced answer: what-if lanes warm-start
+                # from it while the generation (and thus the shape) holds.
+                self._base_solution = (self.load_monitor.model_generation,
+                                       result.final_placement)
             executed = False
             if not dryrun and result.proposals:
                 self.executor.execute_proposals(result.proposals, wait=False)
@@ -468,9 +554,45 @@ class CruiseControl:
                 except OngoingExecutionError:
                     pass
             raise
+        finally:
+            if pinned:
+                self.resident.release()
+
+    def _build_enriched(self, requirements=None, model_mutator=None
+                        ) -> ClusterModel:
+        """Fresh builder + dead-logdir enrichment + optional mutator.
+
+        Dead logdirs are the ADMIN backend's knowledge (AdminClient
+        describeLogDirs in the reference), not the metadata sampler's:
+        fold them into the model so their replicas solve as offline —
+        without this, fix_offline_replicas would "fix" a healthy model
+        and never evacuate the failed disk.  Logdir ids map to the
+        broker's disk indices (the JBOD contract the capacity resolver
+        uses).  A transient admin-socket failure must not take down
+        every optimization operation (the query is an enrichment, and
+        the anomaly cycle retries) — log it and build without."""
+        builder = self.load_monitor.cluster_model_builder(
+            requirements=requirements)
+        try:
+            offline = self._offline_logdirs() or {}
+        except Exception as e:   # noqa: BLE001 — network seam
+            LOG.warning("offline-logdir query failed (%s); building the "
+                        "model without dead-disk enrichment", e)
+            offline = {}
+        for b_id, disks in offline.items():
+            for d in disks:
+                try:
+                    builder.mark_disk_dead(int(b_id), int(d))
+                except (KeyError, IndexError):
+                    # Broker/disk absent from current metadata (e.g.
+                    # already decommissioned) — nothing to mark.
+                    pass
+        if model_mutator is not None:
+            model_mutator(builder)
+        return builder
 
     def _solve_with_failover(self, optimizer, state, placement, meta,
-                             options, generation):
+                             options, generation, *, refreeze=None):
         """Dispatch the solve; on device loss, fail over to the CPU backend.
 
         The accelerator can die mid-flight (preemption, driver crash, XLA
@@ -480,6 +602,12 @@ class CruiseControl:
         trace span ``degraded`` so operators see the path taken.  The cache
         generation is dropped for the retry: the cached entry may itself be
         poisoned by the dead device.
+
+        ``refreeze`` (when given) rebuilds (state, placement, meta) inside
+        the fallback context: the originals — and the resident model cache
+        they may have come from — live on the failed device, so the retry
+        must not read them.  The callable is responsible for invalidating
+        the resident entry so later requests full-freeze on a live backend.
         """
         try:
             result = optimizer.optimizations(
@@ -498,6 +626,8 @@ class CruiseControl:
         if span is not None:
             span.set("degraded", True)
         with _resilience.cpu_fallback():
+            if refreeze is not None:
+                state, placement, meta = refreeze()
             result = optimizer.optimizations(
                 state, placement, meta, options=options,
                 model_generation=None)
@@ -556,14 +686,35 @@ class CruiseControl:
         one compiled program (BASELINE config #5).  The reference would run
         ``RemoveBrokersRunnable`` once per set; this shares the model build
         and the per-goal compilation across all scenarios."""
-        builder = self.load_monitor.cluster_model_builder()
-        state, placement, meta = self._freeze_bucketed(builder)
-        goal_names = list(goals or self.default_goals)
-        optimizer = (self.optimizer if goal_names == self.default_goals
-                     else GoalOptimizer(constraint=self.constraint,
-                                        goal_names=goal_names))
-        return optimizer.batch_remove_scenarios(
-            state, placement, meta, removal_sets, num_candidates=num_candidates)
+        pinned = False
+        if self.resident.enabled:
+            state, placement, meta = self._resident_snapshot()
+            pinned = True
+        else:
+            builder = self.load_monitor.cluster_model_builder()
+            state, placement, meta = self._freeze_bucketed(builder)
+        try:
+            goal_names = list(goals or self.default_goals)
+            optimizer = (self.optimizer if goal_names == self.default_goals
+                         else GoalOptimizer(constraint=self.constraint,
+                                            goal_names=goal_names))
+            # Warm start: when the base cluster was already solved this
+            # generation, lanes begin from that balanced placement instead of
+            # the raw snapshot — each lane only repairs its own removal's
+            # damage, and the batched while_loop's per-lane progress guard
+            # exits those lanes in a handful of rounds.
+            warm = None
+            base = self._base_solution
+            if (base is not None
+                    and base[0] == self.load_monitor.model_generation
+                    and base[1].broker.shape == placement.broker.shape):
+                warm = base[1]
+            return optimizer.batch_remove_scenarios(
+                state, placement, meta, removal_sets,
+                num_candidates=num_candidates, warm_start=warm)
+        finally:
+            if pinned:
+                self.resident.release()
 
     def demote_brokers(self, broker_ids: Sequence[int],
                        dryrun: bool = True) -> OperationResult:
@@ -682,6 +833,7 @@ class CruiseControl:
                 "isProposalReady": True,
                 "goalReadiness": [
                     {"name": g, "status": "ready"} for g in self.default_goals],
+                "residentModel": self.resident.stats(),
             },
         }
 

@@ -192,6 +192,89 @@ def load_conservation(m: Materialized) -> List[str]:
     return out
 
 
+def resident_delta_equivalence(m: Materialized) -> List[str]:
+    """Metamorphic check of the resident-model delta path: after rounds of
+    random journalled mutations (loads, leadership, broker liveness,
+    replica create/delete), the tensors produced by scatter-applying the
+    collected deltas must be BITWISE equal to a fresh full freeze of the
+    same builder.  Any dtype/rounding/ordering divergence between the two
+    paths would let solver answers depend on how the model reached the
+    device, which the steady-state resident cache must never allow."""
+    from cruise_control_tpu.model.builder import builder_from_snapshot
+    from cruise_control_tpu.model.state import apply_deltas
+
+    pad_r, pad_b = m.scenario.pad_replicas_to, m.scenario.pad_brokers_to
+    cm = builder_from_snapshot(m.state, m.placement, m.meta)
+    cm.enable_delta_tracking()
+    # NOTE: apply_deltas DONATES its inputs — these locals are rebound on
+    # every apply and the donated arrays are never touched again.
+    state, placement, _ = cm.freeze(pad_replicas_to=pad_r,
+                                    pad_brokers_to=pad_b)
+    rng = np.random.default_rng(m.scenario.seed ^ 0x5EED)
+    out: List[str] = []
+    applied = 0
+    for _ in range(3):
+        parts = list(cm.partitions().keys())
+        broker_ids = [b.broker_id for b in cm.brokers()]
+        for _ in range(8):
+            t, p = parts[int(rng.integers(len(parts)))]
+            rs = cm.partition(t, p)
+            if not rs:
+                continue
+            op = int(rng.integers(0, 4))
+            if op == 0:
+                for r in list(rs):
+                    cm.set_replica_load(t, p, r.broker_id,
+                                        rng.uniform(0.5, 40.0, size=4))
+            elif op == 1 and len(rs) >= 2:
+                leader = next((r for r in rs if r.is_leader), None)
+                follower = next((r for r in rs if not r.is_leader), None)
+                if leader is not None and follower is not None:
+                    cm.relocate_leadership(t, p, leader.broker_id,
+                                           follower.broker_id)
+            elif op == 2:
+                b = cm.broker(broker_ids[int(rng.integers(len(broker_ids)))])
+                cm.set_broker_state(b.broker_id, alive=not b.alive)
+            elif len(rs) >= 2 and int(rng.integers(2)):
+                cm.delete_replica(t, p, rs[-1].broker_id)
+            else:
+                held = {r.broker_id for r in rs}
+                free = [b for b in broker_ids if b not in held]
+                if free:
+                    cm.create_replica(t, p, broker_id=free[0], index=len(rs),
+                                      is_leader=False)
+                    cm.set_replica_load(t, p, free[0],
+                                        rng.uniform(0.5, 40.0, size=4))
+        delta = cm.collect_delta()
+        if delta is None:
+            # Inexpressible edit / overflow: the service would full-freeze
+            # here, which is trivially equivalent — re-anchor and continue.
+            state, placement, _ = cm.freeze(pad_replicas_to=pad_r,
+                                            pad_brokers_to=pad_b)
+            continue
+        state, placement = apply_deltas(state, placement, delta,
+                                        pad_replica_updates_to=256,
+                                        pad_broker_updates_to=16)
+        applied += 1
+    want_s, want_p, _ = cm.freeze(pad_replicas_to=pad_r,
+                                  pad_brokers_to=pad_b)
+    for name in ("leader_load", "follower_load", "partition", "topic", "pos",
+                 "orig_broker", "offline", "valid", "capacity", "alive",
+                 "new_broker", "broker_valid", "disk_capacity", "disk_alive"):
+        a = np.asarray(getattr(state, name))
+        b = np.asarray(getattr(want_s, name))
+        if a.dtype != b.dtype or a.shape != b.shape or not (a == b).all():
+            out.append(f"state.{name}: delta path != fresh freeze")
+    for name in ("broker", "disk", "is_leader"):
+        a = np.asarray(getattr(placement, name))
+        b = np.asarray(getattr(want_p, name))
+        if not (a == b).all():
+            out.append(f"placement.{name}: delta path != fresh freeze")
+    if applied == 0:
+        out.append("no delta was ever applied (mutation stream degenerate)")
+    return out
+
+
 # --------------------------------------------------------------------------
 # kind-specific invariants
 # --------------------------------------------------------------------------
@@ -272,6 +355,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "soft_goals_no_regression": soft_goals_no_regression,
     "proposals_executable": proposals_executable,
     "load_conservation": load_conservation,
+    "resident_delta_equivalence": resident_delta_equivalence,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

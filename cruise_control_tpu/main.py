@@ -264,8 +264,14 @@ def build_app(config: CruiseControlConfig,
             **notifier_kwargs)
     else:
         notifier = SelfHealingNotifier(**notifier_kwargs)
+    from cruise_control_tpu.model.resident import ResidentModelService
+    resident = ResidentModelService(
+        enabled=bool(config["model.resident.enabled"]),
+        max_delta_slots=int(config["model.resident.max.delta.slots"]),
+        max_delta_chain=int(config["model.resident.max.delta.chain"]))
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,
+        resident_service=resident,
         constraint=config.balancing_constraint(),
         default_goals=config.goal_names("default.goals"),
         notifier=notifier,

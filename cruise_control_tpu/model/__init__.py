@@ -1,5 +1,7 @@
-from cruise_control_tpu.model.state import ClusterState, Placement, ClusterMeta
-from cruise_control_tpu.model.builder import ClusterModel, Broker, Replica
+from cruise_control_tpu.model.state import (
+    ClusterState, Placement, ClusterMeta, ClusterDelta, apply_deltas)
+from cruise_control_tpu.model.builder import (
+    ClusterModel, Broker, Replica, builder_from_snapshot)
 from cruise_control_tpu.model import ops
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
 from cruise_control_tpu.model.sanity import sanity_check

@@ -1,0 +1,276 @@
+"""Device-resident cluster model: keep frozen tensors on-device across
+requests and scatter-apply builder deltas instead of re-freezing.
+
+Every propose/what-if request used to pay a full O(cluster) host pack plus a
+host→device transfer (``builder.freeze``) before the solver even started.
+The :class:`ResidentModelService` pins the last (ClusterState, Placement,
+ClusterMeta) triple, keyed by its compilesvc shape bucket, and on the next
+request asks the builder for a :class:`~cruise_control_tpu.model.state.
+ClusterDelta` — a sparse edit script applied into the *donated* device
+buffers by a stable-shaped scatter kernel.  A full freeze happens only when
+the delta contract cannot hold:
+
+- no resident entry yet, or a different builder object (monitor rebuilt);
+- the shape bucket changed (cluster outgrew / shrank past a pad boundary);
+- the builder journalled an inexpressible edit (new broker, apply_placement);
+- the delta overflowed ``max_delta_slots`` touched rows;
+- ``max_delta_chain`` consecutive applies since the last full freeze (bounds
+  drift from float scatter reordering — none observed, but cheap insurance);
+- an explicit :meth:`invalidate` (solver device failover, config reload).
+
+Sensors: ``Model.full-freezes``, ``Model.delta-applies``,
+``Model.resident-invalidations``.  Spans: ``model.freeze`` (host pack),
+``model.transfer`` (host→device), ``model.delta_apply`` — so ``/trace``
+proves where the milliseconds went.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.metrics import registry as _metric_registry
+from cruise_control_tpu.compilesvc.buckets import geometric_bucket
+from cruise_control_tpu.model.builder import ClusterModel
+from cruise_control_tpu.model.state import (
+    ClusterDelta,
+    ClusterMeta,
+    ClusterState,
+    Placement,
+    apply_deltas,
+    device_put_state,
+    empty_delta,
+)
+from cruise_control_tpu.obsvc.tracer import tracer as _tracer
+
+LOG = logging.getLogger(__name__)
+
+FULL_FREEZE_SENSOR = "Model.full-freezes"
+DELTA_APPLY_SENSOR = "Model.delta-applies"
+INVALIDATION_SENSOR = "Model.resident-invalidations"
+
+# Update-slot padding ladder floor: deltas are padded up to a geometric slot
+# bucket so the scatter executable's shape stays stable across requests.
+DELTA_SLOT_FLOOR = 64
+DELTA_SLOT_GROWTH = 2.0
+
+
+class ResidentModelService:
+    """Owns the device-resident (state, placement, meta) triple.
+
+    All access is serialized by :attr:`lock`; the facade holds it across the
+    monitor's builder update + snapshot so delta collection never races a
+    concurrent request's apply.
+    """
+
+    def __init__(self, enabled: bool = True, max_delta_slots: int = 8192,
+                 max_delta_chain: int = 512,
+                 slot_floor: int = DELTA_SLOT_FLOOR,
+                 slot_growth: float = DELTA_SLOT_GROWTH,
+                 pin_wait_s: float = 0.5):
+        self.enabled = bool(enabled)
+        self.max_delta_slots = int(max_delta_slots)
+        self.max_delta_chain = int(max_delta_chain)
+        # How long a delta apply waits for pinned solves to drain before
+        # falling back to a (never-donating) full freeze.  Short by default:
+        # the stall only happens under concurrent solves — boot warmup /
+        # precompute overlapping a request — and there a full freeze is
+        # cheaper than serializing behind a cold compile.
+        self.pin_wait_s = float(pin_wait_s)
+        self.slot_floor = int(slot_floor)
+        self.slot_growth = float(slot_growth)
+        self.lock = threading.RLock()
+        # Requests "pin" the tensors they received while their solve is in
+        # flight; a delta apply donates (and thereby deletes) the resident
+        # buffers, so it waits for the pin count to drain first.
+        self._cond = threading.Condition(self.lock)
+        self._pins = 0
+        self._entry: Optional[dict] = None
+        self._invalidation_reasons: Dict[str, int] = {}
+        # Materialize the counters at construction so /metrics (and the
+        # sensor-drift guard) see them before the first request.
+        reg = _metric_registry()
+        self._full_freezes = reg.counter(FULL_FREEZE_SENSOR)
+        self._delta_applies = reg.counter(DELTA_APPLY_SENSOR)
+        self._invalidations = reg.counter(INVALIDATION_SENSOR)
+
+    # ------------------------------------------------------------------ public
+
+    def delta_slots(self, n: int) -> int:
+        """Pad an update count to its geometric slot bucket (capped at
+        ``max_delta_slots`` — collect already refused anything larger)."""
+        return min(geometric_bucket(max(n, 1), self.slot_floor,
+                                    self.slot_growth),
+                   max(self.max_delta_slots, self.slot_floor))
+
+    def invalidate(self, reason: str) -> None:
+        """Drop the resident entry (e.g. after a device failure the buffers
+        may be corrupt or unreachable; after failover they live on the wrong
+        backend).  The next snapshot will full-freeze."""
+        with self.lock:
+            if self._entry is None:
+                return
+            self._entry = None
+            self._invalidations.inc()
+            self._invalidation_reasons[reason] = (
+                self._invalidation_reasons.get(reason, 0) + 1)
+            LOG.info("resident model invalidated: %s", reason)
+
+    def snapshot(self, builder_or_fn,
+                 pad_fn: Callable[[int, int], Tuple[int, int]],
+                 pin: bool = False,
+                 ) -> Tuple[ClusterState, Placement, ClusterMeta]:
+        """Return device tensors for the builder's current state — via delta
+        apply into the resident buffers when possible, via full freeze
+        otherwise.  ``pad_fn`` maps true (replicas, brokers) counts to the
+        padded bucket (``compile_service().pad_targets``).
+
+        ``builder_or_fn`` is a ClusterModel or a zero-arg callable returning
+        one; callables run under :attr:`lock` so monitor-side builder updates
+        cannot race a concurrent request's delta collection.  With
+        ``pin=True`` the returned tensors are pinned against donation until
+        the caller invokes :meth:`release` (wrap the solve in try/finally).
+        """
+        with self.lock:
+            builder = builder_or_fn() if callable(builder_or_fn) \
+                else builder_or_fn
+            n_r, n_b = builder.counts()
+            bucket = pad_fn(n_r, n_b)
+            e = self._entry
+            delta: Optional[ClusterDelta] = None
+            if (self.enabled and e is not None and e["builder"] is builder
+                    and e["bucket"] == bucket and builder.delta_tracking):
+                if builder.version == e["version"]:
+                    delta = empty_delta(e["version"], e["version"])
+                elif e["chain"] < self.max_delta_chain:
+                    delta = builder.collect_delta(
+                        max_updates=self.max_delta_slots)
+            if delta is not None:
+                if delta.is_empty and builder.version == e["version"]:
+                    out = e["state"], e["placement"], e["meta"]
+                elif self._wait_unpinned(self.pin_wait_s):
+                    out = self._apply(e, builder, delta)
+                else:
+                    # A pin leaked or a solve is wedged; a full freeze is
+                    # always safe (it never donates the old buffers).
+                    LOG.warning("resident pins did not drain; falling back "
+                                "to full freeze")
+                    out = self._full_freeze(builder, bucket)
+            else:
+                out = self._full_freeze(builder, bucket)
+            if pin:
+                self._pins += 1
+            return out
+
+    def release(self) -> None:
+        """Drop a ``pin=True`` snapshot's pin; lets pending deltas donate."""
+        with self._cond:
+            self._pins = max(0, self._pins - 1)
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self.lock:
+            e = self._entry
+            return {
+                "enabled": self.enabled,
+                "resident": e is not None,
+                "bucket": list(e["bucket"]) if e else None,
+                "deltaChain": e["chain"] if e else 0,
+                "modelVersion": e["version"] if e else None,
+                "fullFreezes": int(self._full_freezes.count),
+                "deltaApplies": int(self._delta_applies.count),
+                "invalidations": int(self._invalidations.count),
+                "invalidationReasons": dict(self._invalidation_reasons),
+            }
+
+    def warm_scatter(self, pad_r: int, pad_b: int, num_disks: int = 1) -> None:
+        """Compile the delta-apply executables for a shape bucket at boot:
+        run both kernels (plain scatter and perm+scatter) once over zeroed
+        tensors with a floor-sized no-op delta."""
+        import jax.numpy as jnp  # local: keep module import light
+        from cruise_control_tpu.common.resources import NUM_RESOURCES
+
+        def zeros():
+            state = ClusterState(
+                leader_load=jnp.zeros((pad_r, NUM_RESOURCES), jnp.float32),
+                follower_load=jnp.zeros((pad_r, NUM_RESOURCES), jnp.float32),
+                partition=jnp.zeros(pad_r, jnp.int32),
+                topic=jnp.zeros(pad_r, jnp.int32),
+                pos=jnp.zeros(pad_r, jnp.int32),
+                orig_broker=jnp.zeros(pad_r, jnp.int32),
+                offline=jnp.zeros(pad_r, bool),
+                valid=jnp.zeros(pad_r, bool),
+                capacity=jnp.zeros((pad_b, NUM_RESOURCES), jnp.float32),
+                host=jnp.zeros(pad_b, jnp.int32),
+                rack=jnp.zeros(pad_b, jnp.int32),
+                alive=jnp.zeros(pad_b, bool),
+                new_broker=jnp.zeros(pad_b, bool),
+                broker_valid=jnp.zeros(pad_b, bool),
+                disk_capacity=jnp.zeros((pad_b, num_disks), jnp.float32),
+                disk_alive=jnp.zeros((pad_b, num_disks), bool),
+            )
+            placement = Placement(broker=jnp.zeros(pad_r, jnp.int32),
+                                  disk=jnp.zeros(pad_r, jnp.int32),
+                                  is_leader=jnp.zeros(pad_r, bool))
+            return state, placement
+
+        slots = self.delta_slots(1)
+        for perm in (None, np.arange(pad_r, dtype=np.int32)):
+            st, pl = zeros()
+            d = empty_delta()
+            d.perm = perm
+            st, pl = apply_deltas(st, pl, d, slots, 1)
+            st.valid.block_until_ready()
+
+    # ----------------------------------------------------------------- private
+
+    def _wait_unpinned(self, timeout: float) -> bool:
+        """Wait for pinned solves to drain (donation deletes the buffers they
+        are using).  Condition shares :attr:`lock`, so waiting releases it
+        and pinned requests can finish and call :meth:`release`."""
+        deadline = time.monotonic() + timeout
+        while self._pins > 0:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self._cond.wait(timeout=min(remaining, 1.0))
+        return True
+
+    def _apply(self, entry: dict, builder: ClusterModel, delta: ClusterDelta,
+               ) -> Tuple[ClusterState, Placement, ClusterMeta]:
+        slots = self.delta_slots(int(delta.replica_idx.shape[0]))
+        b_slots = max(1, min(self.slot_floor,
+                             entry["state"].num_brokers_padded))
+        with _tracer().span("model.delta_apply", updates=delta.num_updates,
+                            structural=delta.perm is not None):
+            state, placement = apply_deltas(
+                entry["state"], entry["placement"], delta,
+                pad_replica_updates_to=slots,
+                pad_broker_updates_to=b_slots)
+        meta = delta.meta if delta.meta is not None else entry["meta"]
+        entry.update(state=state, placement=placement, meta=meta,
+                     version=builder.version, chain=entry["chain"] + 1)
+        self._delta_applies.inc()
+        return state, placement, meta
+
+    def _full_freeze(self, builder: ClusterModel, bucket: Tuple[int, int],
+                     ) -> Tuple[ClusterState, Placement, ClusterMeta]:
+        n_r, n_b = builder.counts()
+        if self.enabled and not builder.delta_tracking:
+            builder.enable_delta_tracking()
+        with _tracer().span("model.freeze", replicas=n_r, brokers=n_b):
+            packed, meta = builder.freeze_packed(pad_replicas_to=bucket[0],
+                                                 pad_brokers_to=bucket[1])
+        with _tracer().span("model.transfer"):
+            state, placement = device_put_state(packed)
+            state.valid.block_until_ready()
+        self._full_freezes.inc()
+        if self.enabled:
+            self._entry = dict(builder=builder, bucket=bucket, state=state,
+                               placement=placement, meta=meta,
+                               version=builder.version, chain=0)
+        return state, placement, meta

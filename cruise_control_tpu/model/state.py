@@ -25,9 +25,12 @@ flip instead of an in-place load mutation.
 
 from __future__ import annotations
 
+import dataclasses
+from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import flax.struct
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -143,18 +146,16 @@ def _pad_to(n: int, multiple: int) -> int:
     return max(((n + multiple - 1) // multiple) * multiple, multiple)
 
 
-def make_state(
+def pack_state_arrays(
     arrays: Dict[str, np.ndarray],
     pad_replicas_to: int = 1,
     pad_brokers_to: int = 1,
-) -> Tuple[ClusterState, Placement]:
-    """Pack host numpy arrays into (ClusterState, Placement) with padding.
+) -> Dict[str, np.ndarray]:
+    """Host-side half of :func:`make_state`: pad and coerce the unpadded
+    per-replica / per-broker numpy arrays to their final device dtypes.
 
-    ``arrays`` holds unpadded per-replica and per-broker arrays keyed by the
-    field names of ClusterState/Placement.  Padding multiples let callers keep
-    jit caches warm across snapshots of slightly different size (pad replicas
-    to e.g. 8192, brokers to 128 → recompiles only on size-class change).
-    """
+    Split out so the resident-model path can time (and span) the pure host
+    packing work separately from the host→device transfer."""
     r = arrays["leader_load"].shape[0]
     b = arrays["capacity"].shape[0]
     rp = _pad_to(r, pad_replicas_to)
@@ -172,30 +173,240 @@ def make_state(
         pad = [(0, bp - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         return np.pad(x, pad, constant_values=fill)
 
-    valid = padr(np.ones(r, dtype=bool), False)
-    broker_valid = padb(np.ones(b, dtype=bool), False)
+    return dict(
+        leader_load=padr(arrays["leader_load"].astype(np.float32)),
+        follower_load=padr(arrays["follower_load"].astype(np.float32)),
+        partition=padr(arrays["partition"].astype(np.int32)),
+        topic=padr(arrays["topic"].astype(np.int32)),
+        pos=padr(arrays["pos"].astype(np.int32)),
+        orig_broker=padr(arrays["orig_broker"].astype(np.int32)),
+        offline=padr(arrays.get("offline", np.zeros(r, dtype=bool)).astype(bool)),
+        valid=padr(np.ones(r, dtype=bool), False),
+        capacity=padb(arrays["capacity"].astype(np.float32)),
+        host=padb(arrays["host"].astype(np.int32)),
+        rack=padb(arrays["rack"].astype(np.int32)),
+        alive=padb(arrays.get("alive", np.ones(b, dtype=bool)), False),
+        new_broker=padb(arrays.get("new_broker", np.zeros(b, dtype=bool)), False),
+        broker_valid=padb(np.ones(b, dtype=bool), False),
+        disk_capacity=padb(arrays["disk_capacity"].astype(np.float32)),
+        disk_alive=padb(arrays["disk_alive"].astype(bool), False),
+        assignment=padr(arrays["assignment"].astype(np.int32)),
+        disk=padr(arrays.get("disk", np.zeros(r, dtype=np.int32)).astype(np.int32)),
+        is_leader=padr(arrays["is_leader"].astype(bool)),
+    )
 
+
+def device_put_state(packed: Dict[str, np.ndarray]) -> Tuple[ClusterState, Placement]:
+    """Device half of :func:`make_state`: ship packed host arrays to the
+    accelerator as (ClusterState, Placement)."""
     state = ClusterState(
-        leader_load=jnp.asarray(padr(arrays["leader_load"].astype(np.float32))),
-        follower_load=jnp.asarray(padr(arrays["follower_load"].astype(np.float32))),
-        partition=jnp.asarray(padr(arrays["partition"].astype(np.int32))),
-        topic=jnp.asarray(padr(arrays["topic"].astype(np.int32))),
-        pos=jnp.asarray(padr(arrays["pos"].astype(np.int32))),
-        orig_broker=jnp.asarray(padr(arrays["orig_broker"].astype(np.int32))),
-        offline=jnp.asarray(padr(arrays.get("offline", np.zeros(r, dtype=bool)).astype(bool))),
-        valid=jnp.asarray(valid),
-        capacity=jnp.asarray(padb(arrays["capacity"].astype(np.float32))),
-        host=jnp.asarray(padb(arrays["host"].astype(np.int32))),
-        rack=jnp.asarray(padb(arrays["rack"].astype(np.int32))),
-        alive=jnp.asarray(padb(arrays.get("alive", np.ones(b, dtype=bool)), False)),
-        new_broker=jnp.asarray(padb(arrays.get("new_broker", np.zeros(b, dtype=bool)), False)),
-        broker_valid=jnp.asarray(broker_valid),
-        disk_capacity=jnp.asarray(padb(arrays["disk_capacity"].astype(np.float32))),
-        disk_alive=jnp.asarray(padb(arrays["disk_alive"].astype(bool), False)),
+        leader_load=jnp.asarray(packed["leader_load"]),
+        follower_load=jnp.asarray(packed["follower_load"]),
+        partition=jnp.asarray(packed["partition"]),
+        topic=jnp.asarray(packed["topic"]),
+        pos=jnp.asarray(packed["pos"]),
+        orig_broker=jnp.asarray(packed["orig_broker"]),
+        offline=jnp.asarray(packed["offline"]),
+        valid=jnp.asarray(packed["valid"]),
+        capacity=jnp.asarray(packed["capacity"]),
+        host=jnp.asarray(packed["host"]),
+        rack=jnp.asarray(packed["rack"]),
+        alive=jnp.asarray(packed["alive"]),
+        new_broker=jnp.asarray(packed["new_broker"]),
+        broker_valid=jnp.asarray(packed["broker_valid"]),
+        disk_capacity=jnp.asarray(packed["disk_capacity"]),
+        disk_alive=jnp.asarray(packed["disk_alive"]),
     )
     placement = Placement(
-        broker=jnp.asarray(padr(arrays["assignment"].astype(np.int32))),
-        disk=jnp.asarray(padr(arrays.get("disk", np.zeros(r, dtype=np.int32)).astype(np.int32))),
-        is_leader=jnp.asarray(padr(arrays["is_leader"].astype(bool))),
+        broker=jnp.asarray(packed["assignment"]),
+        disk=jnp.asarray(packed["disk"]),
+        is_leader=jnp.asarray(packed["is_leader"]),
     )
     return state, placement
+
+
+def make_state(
+    arrays: Dict[str, np.ndarray],
+    pad_replicas_to: int = 1,
+    pad_brokers_to: int = 1,
+) -> Tuple[ClusterState, Placement]:
+    """Pack host numpy arrays into (ClusterState, Placement) with padding.
+
+    ``arrays`` holds unpadded per-replica and per-broker arrays keyed by the
+    field names of ClusterState/Placement.  Padding multiples let callers keep
+    jit caches warm across snapshots of slightly different size (pad replicas
+    to e.g. 8192, brokers to 128 → recompiles only on size-class change).
+    """
+    return device_put_state(
+        pack_state_arrays(arrays, pad_replicas_to, pad_brokers_to))
+
+
+# --------------------------------------------------------------------- deltas
+
+# Replica-axis fields a delta may rewrite, with the per-row shape/dtype each
+# update array must carry.  ``broker``/``disk``/``is_leader`` live on
+# Placement; everything else on ClusterState.
+REPLICA_DELTA_FIELDS: Tuple[Tuple[str, Any, Tuple[int, ...]], ...] = (
+    ("leader_load", np.float32, (NUM_RESOURCES,)),
+    ("follower_load", np.float32, (NUM_RESOURCES,)),
+    ("partition", np.int32, ()),
+    ("topic", np.int32, ()),
+    ("pos", np.int32, ()),
+    ("orig_broker", np.int32, ()),
+    ("offline", np.bool_, ()),
+    ("valid", np.bool_, ()),
+    ("broker", np.int32, ()),
+    ("disk", np.int32, ()),
+    ("is_leader", np.bool_, ()),
+)
+
+BROKER_DELTA_FIELDS: Tuple[Tuple[str, Any], ...] = (
+    ("capacity", np.float32),
+    ("alive", np.bool_),
+    ("new_broker", np.bool_),
+    ("disk_capacity", np.float32),
+    ("disk_alive", np.bool_),
+)
+
+
+@dataclasses.dataclass
+class ClusterDelta:
+    """A sparse host-side edit script against a frozen snapshot.
+
+    ``replica_idx``/``broker_idx`` name the rows to rewrite; the update dicts
+    carry one array per rewritten field (same dtypes as the frozen tensors).
+    ``perm`` (when set) is a full row permutation applied *before* the
+    scatter: ``new_row i ← old_row perm[i]`` — it carries surviving rows to
+    their new positions after replica creation/deletion shifted the dense
+    partition ids; fresh and freed rows are always also in ``replica_idx`` so
+    their post-gather content is fully overwritten.  ``meta`` replaces the
+    snapshot's ClusterMeta when the partition table changed.
+    """
+
+    replica_idx: np.ndarray                  # i32[U]
+    replica_updates: Dict[str, np.ndarray]   # REPLICA_DELTA_FIELDS arrays, [U,...]
+    broker_idx: np.ndarray                   # i32[V]
+    broker_updates: Dict[str, np.ndarray]    # BROKER_DELTA_FIELDS arrays, [V,...]
+    perm: Optional[np.ndarray] = None        # i32[R_pad]
+    meta: Optional["ClusterMeta"] = None
+    from_version: int = 0
+    to_version: int = 0
+
+    @property
+    def num_updates(self) -> int:
+        return int(self.replica_idx.shape[0]) + int(self.broker_idx.shape[0])
+
+    @property
+    def is_empty(self) -> bool:
+        return self.num_updates == 0 and self.perm is None
+
+
+def empty_delta(from_version: int = 0, to_version: int = 0) -> ClusterDelta:
+    z = np.zeros(0, dtype=np.int32)
+    return ClusterDelta(
+        replica_idx=z,
+        replica_updates={k: np.zeros((0,) + shp, dtype=dt)
+                         for k, dt, shp in REPLICA_DELTA_FIELDS},
+        broker_idx=z.copy(),
+        broker_updates={},
+        from_version=from_version, to_version=to_version)
+
+
+def _scatter_body(state: ClusterState, placement: Placement, r_idx, r_upd,
+                  b_idx, b_upd) -> Tuple[ClusterState, Placement]:
+    """Shared scatter tail of both delta kernels.  Padding slots carry an
+    out-of-range index, so ``mode="drop"`` makes them no-ops — the executable
+    shape depends only on the (bucketed) slot counts, never on how many real
+    updates a particular delta carries."""
+    sr = lambda arr, key: arr.at[r_idx].set(r_upd[key], mode="drop")
+    state = state.replace(
+        leader_load=sr(state.leader_load, "leader_load"),
+        follower_load=sr(state.follower_load, "follower_load"),
+        partition=sr(state.partition, "partition"),
+        topic=sr(state.topic, "topic"),
+        pos=sr(state.pos, "pos"),
+        orig_broker=sr(state.orig_broker, "orig_broker"),
+        offline=sr(state.offline, "offline"),
+        valid=sr(state.valid, "valid"),
+    )
+    if b_upd:
+        sb = lambda arr, key: arr.at[b_idx].set(b_upd[key], mode="drop")
+        state = state.replace(
+            capacity=sb(state.capacity, "capacity"),
+            alive=sb(state.alive, "alive"),
+            new_broker=sb(state.new_broker, "new_broker"),
+            disk_capacity=sb(state.disk_capacity, "disk_capacity"),
+            disk_alive=sb(state.disk_alive, "disk_alive"),
+        )
+    placement = placement.replace(
+        broker=sr(placement.broker, "broker"),
+        disk=sr(placement.disk, "disk"),
+        is_leader=sr(placement.is_leader, "is_leader"),
+    )
+    return state, placement
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _apply_delta_scatter(state, placement, r_idx, r_upd, b_idx, b_upd):
+    return _scatter_body(state, placement, r_idx, r_upd, b_idx, b_upd)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _apply_delta_perm_scatter(state, placement, perm, r_idx, r_upd, b_idx,
+                              b_upd):
+    # Gather surviving rows to their new positions first.  ``perm`` entries
+    # for fresh rows are negative: the clip makes the gather well-defined and
+    # the subsequent scatter (which always covers fresh rows) overwrites the
+    # junk it fetched.
+    cl = jnp.clip(perm, 0, state.leader_load.shape[0] - 1)
+    g = lambda x: jnp.take(x, cl, axis=0)
+    state = state.replace(
+        leader_load=g(state.leader_load), follower_load=g(state.follower_load),
+        partition=g(state.partition), topic=g(state.topic), pos=g(state.pos),
+        orig_broker=g(state.orig_broker), offline=g(state.offline),
+        valid=g(state.valid))
+    placement = placement.replace(
+        broker=g(placement.broker), disk=g(placement.disk),
+        is_leader=g(placement.is_leader))
+    return _scatter_body(state, placement, r_idx, r_upd, b_idx, b_upd)
+
+
+def _pad_updates(idx: np.ndarray, upd: Dict[str, np.ndarray], slots: int,
+                 sentinel: int) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    n = idx.shape[0]
+    slots = max(slots, n, 1)
+    out_idx = np.full(slots, sentinel, dtype=np.int32)
+    out_idx[:n] = idx
+    out = {}
+    for k, v in upd.items():
+        buf = np.zeros((slots,) + v.shape[1:], dtype=v.dtype)
+        buf[:n] = v
+        out[k] = jnp.asarray(buf)
+    return jnp.asarray(out_idx), out
+
+
+def apply_deltas(
+    state: ClusterState,
+    placement: Placement,
+    delta: ClusterDelta,
+    pad_replica_updates_to: int = 1,
+    pad_broker_updates_to: int = 1,
+) -> Tuple[ClusterState, Placement]:
+    """Scatter-apply a :class:`ClusterDelta` into **donated** device buffers.
+
+    The inputs ``state``/``placement`` are consumed (XLA may reuse their
+    memory); callers must drop every reference to them afterwards.  Update
+    arrays are padded up to the requested slot counts so repeated applies at
+    the same (R_pad, B_pad, slot) bucket hit one compiled executable.
+    """
+    rp = state.num_replicas_padded
+    bp = state.num_brokers_padded
+    r_idx, r_upd = _pad_updates(delta.replica_idx, delta.replica_updates,
+                                pad_replica_updates_to, rp)
+    b_idx, b_upd = _pad_updates(delta.broker_idx, delta.broker_updates,
+                                pad_broker_updates_to, bp)
+    if delta.perm is not None:
+        perm = jnp.asarray(delta.perm.astype(np.int32))
+        return _apply_delta_perm_scatter(state, placement, perm, r_idx, r_upd,
+                                         b_idx, b_upd)
+    return _apply_delta_scatter(state, placement, r_idx, r_upd, b_idx, b_upd)

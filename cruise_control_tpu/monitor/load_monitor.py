@@ -101,6 +101,13 @@ class LoadMonitor:
         self._model_semaphore = threading.BoundedSemaphore(
             max_concurrent_model_generations)
         self._resource_matrix = md.COMMON_METRIC_DEF.resource_matrix()
+        # Resident-builder bookkeeping (resident_model_builder): one kept
+        # ClusterModel that is *updated in place* between requests so the
+        # resident model service can ingest deltas instead of re-freezing.
+        self._resident_builder: Optional[ClusterModel] = None
+        self._resident_fp = None
+        self._resident_loads: Dict[Tuple[str, int], np.ndarray] = {}
+        self._resident_alive: Dict[int, bool] = {}
         self._register_sensors()
 
     def _register_sensors(self) -> None:
@@ -226,6 +233,101 @@ class LoadMonitor:
         return self._populate(metadata, result,
                               kwargs.get("allow_capacity_estimation", True))
 
+    # ------------------------------------------------------ resident builder
+
+    def _metadata_fingerprint(self, metadata: ClusterMetadata,
+                              allow_capacity_estimation: bool):
+        """Structural identity of the cluster as _populate would build it.
+        Order-sensitive on purpose: broker/partition iteration order decides
+        dense indices, so a reordering is a different model.  Broker liveness
+        is deliberately excluded — alive flips are expressible as deltas."""
+        return (
+            tuple((b.broker_id, b.rack, b.host) for b in metadata.brokers),
+            tuple((p.topic, p.partition, p.leader, tuple(p.replicas))
+                  for p in metadata.partitions),
+            bool(allow_capacity_estimation),
+        )
+
+    def reset_resident_builder(self) -> None:
+        """Drop the kept builder; the next resident request rebuilds fresh
+        (used when out-of-band state the diff cannot see changed, e.g. the
+        set of offline logdirs, or after a device failover)."""
+        self._resident_builder = None
+
+    def resident_model_builder(
+        self,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        allow_capacity_estimation: bool = True,
+    ) -> Tuple[ClusterModel, bool]:
+        """Return ``(builder, fresh)`` where ``builder`` is the *kept*
+        delta-tracking ClusterModel updated in place from the latest metadata
+        + aggregates, and ``fresh`` says it was rebuilt from scratch (the
+        structural fingerprint changed or no builder existed).
+
+        The steady-state path touches only partitions whose aggregated load
+        vector actually changed and brokers whose liveness flipped, so the
+        builder's journal — and therefore the device delta — stays sparse.
+        Callers must serialize calls (the facade holds the resident-service
+        lock across update + snapshot).
+        """
+        requirements = requirements or ModelCompletenessRequirements()
+        to_ms = time.time() * 1000
+        metadata = self.metadata_client.refresh_metadata()
+        options = AggregationOptions(
+            min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+            min_valid_windows=requirements.min_required_num_windows)
+        result = self.partition_aggregator.aggregate(-float("inf"), to_ms, options)
+        fp = self._metadata_fingerprint(metadata, allow_capacity_estimation)
+        if self._resident_builder is None or fp != self._resident_fp:
+            cm = self._populate(metadata, result, allow_capacity_estimation)
+            cm.enable_delta_tracking()
+            self._resident_builder = cm
+            self._resident_fp = fp
+            self._resident_loads = self._partition_loads(metadata, result)
+            self._resident_alive = {b.broker_id: bool(b.alive)
+                                    for b in metadata.brokers}
+            return cm, True
+
+        cm = self._resident_builder
+        loads = self._partition_loads(metadata, result)
+        prev = self._resident_loads
+        parts = cm.partitions()
+        for tp, load in loads.items():
+            pl = prev.get(tp)
+            if pl is not None and np.array_equal(pl, load):
+                continue
+            for r in list(parts.get(tp, ())):
+                cm.set_replica_load(tp[0], tp[1], r.broker_id, load)
+        for tp in prev.keys() - loads.keys():
+            # Partition dropped out of the monitored set: a fresh _populate
+            # would leave its load at zero.
+            zero = np.zeros_like(prev[tp])
+            for r in list(parts.get(tp, ())):
+                cm.set_replica_load(tp[0], tp[1], r.broker_id, zero)
+        self._resident_loads = loads
+        for b in metadata.brokers:
+            if bool(b.alive) != self._resident_alive.get(b.broker_id, True):
+                cm.set_broker_state(b.broker_id, alive=bool(b.alive))
+                self._resident_alive[b.broker_id] = bool(b.alive)
+        return cm, False
+
+    def _partition_loads(self, metadata: ClusterMetadata, agg_result,
+                         ) -> Dict[Tuple[str, int], np.ndarray]:
+        """Per-partition aggregated load vectors (f64[4]) — the same numbers
+        _populate assigns via set_replica_load."""
+        values = agg_result.values_and_extrapolations
+        mat = self._resource_matrix
+        out: Dict[Tuple[str, int], np.ndarray] = {}
+        for p in metadata.partitions:
+            if not p.replicas:
+                continue
+            vae = values.get((p.topic, p.partition))
+            if vae is None:
+                continue
+            per_metric = vae.values.mean(axis=1)       # f32[M]
+            out[(p.topic, p.partition)] = mat @ per_metric
+        return out
+
     def _populate(self, metadata: ClusterMetadata, agg_result,
                   allow_capacity_estimation: bool) -> ClusterModel:
         cm = ClusterModel()
@@ -238,8 +340,10 @@ class LoadMonitor:
                              capacity={r: float(cap.capacity[int(r)])
                                        for r in Resource},
                              disk_capacities=cap.disk_capacities)
-        values = agg_result.values_and_extrapolations
-        mat = self._resource_matrix
+        # Collapse windows per metric strategy then map to resources
+        # (Load.expectedUtilizationFor :84-98 over the window axis); shared
+        # with the resident diff path so both see identical numbers.
+        loads = self._partition_loads(metadata, agg_result)
         for p in metadata.partitions:
             if not p.replicas:
                 continue
@@ -248,13 +352,9 @@ class LoadMonitor:
                     continue
                 cm.create_replica(p.topic, p.partition, broker_id=broker_id,
                                   index=i, is_leader=(broker_id == p.leader))
-            vae = values.get((p.topic, p.partition))
-            if vae is None:
+            load = loads.get((p.topic, p.partition))
+            if load is None:
                 continue  # not monitored; include_all_topics gate decides upstream
-            # Collapse windows per metric strategy then map to resources
-            # (Load.expectedUtilizationFor :84-98 over the window axis).
-            per_metric = vae.values.mean(axis=1)       # f32[M]
-            load = mat @ per_metric                    # f32[4]
             # Every replica gets the aggregated leader metrics (reference:
             # MonitorUtils.populatePartitionLoad :382-447 sets load per
             # replica); the two-role model derives the follower-role load via

@@ -1,0 +1,292 @@
+"""Resident cluster model: delta collection, scatter apply, and the
+device-resident service.
+
+The contract under test is BITWISE equality: a resident (state, placement)
+updated by ``apply_deltas`` must be indistinguishable from a fresh
+``freeze()`` of the same builder — same dtypes, same rounding, same padding.
+Anything weaker would let solver answers drift between the delta path and
+the re-freeze path.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.metrics import registry
+from cruise_control_tpu.model.builder import ClusterModel, builder_from_snapshot
+from cruise_control_tpu.model.resident import ResidentModelService
+from cruise_control_tpu.model.state import apply_deltas, empty_delta
+from cruise_control_tpu.testing import deterministic as det
+
+PAD_R, PAD_B = 16, 4
+
+STATE_FIELDS = ("leader_load", "follower_load", "partition", "topic", "pos",
+                "orig_broker", "offline", "valid", "capacity", "host", "rack",
+                "alive", "new_broker", "broker_valid", "disk_capacity",
+                "disk_alive")
+PLACEMENT_FIELDS = ("broker", "disk", "is_leader")
+
+
+def _freeze(cm):
+    return cm.freeze(pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+
+
+def assert_bitwise_equal(got, want):
+    gs, gp = got
+    ws, wp = want
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(gs, name)), np.asarray(getattr(ws, name))
+        assert a.dtype == b.dtype and a.shape == b.shape, name
+        assert (a == b).all(), f"state.{name} diverged"
+    for name in PLACEMENT_FIELDS:
+        a, b = np.asarray(getattr(gp, name)), np.asarray(getattr(wp, name))
+        assert a.dtype == b.dtype and (a == b).all(), \
+            f"placement.{name} diverged"
+
+
+def tracked_cluster():
+    cm = det.small_cluster_model()
+    cm.enable_delta_tracking()
+    return cm
+
+
+# ----------------------------------------------------------- delta collection
+
+
+def test_counts_maintained_incrementally():
+    cm = det.small_cluster_model()
+    n_r = sum(len(rs) for rs in cm.partitions().values())
+    assert cm.counts() == (n_r, len(cm.brokers()))
+    cm.create_replica("T9", 0, broker_id=0, index=0, is_leader=True)
+    assert cm.counts()[0] == n_r + 1
+    cm.delete_replica("T9", 0, 0)
+    assert cm.counts()[0] == n_r
+
+
+def test_sparse_delta_bitwise_equal_to_fresh_freeze():
+    cm = tracked_cluster()
+    state, placement, meta = _freeze(cm)
+    v0 = cm.version
+
+    cm.set_replica_load("T1", 0, 0, det.load(33.0, 101.5, 77.25, 13.0))
+    cm.set_broker_state(2, alive=False)          # liveness flip: delta rows
+    cm.relocate_leadership("T2", 1, 0, 2)
+
+    delta = cm.collect_delta()
+    assert delta is not None and delta.perm is None
+    assert delta.from_version == v0 and delta.to_version == cm.version
+    assert delta.num_updates > 0
+    got_s, got_p = apply_deltas(state, placement, delta,
+                                pad_replica_updates_to=8,
+                                pad_broker_updates_to=4)
+    want_s, want_p, want_m = _freeze(cm)
+    assert_bitwise_equal((got_s, got_p), (want_s, want_p))
+    assert want_m.extra["model_version"] == cm.version
+
+
+def test_structural_delta_uses_perm_and_matches():
+    cm = tracked_cluster()
+    state, placement, meta = _freeze(cm)
+
+    cm.delete_replica("T2", 0, 2)                # shifts row ordering
+    cm.create_replica("T2", 3, broker_id=1, index=0, is_leader=True)
+    cm.set_replica_load("T2", 3, 1, det.load(1.0, 2.0, 3.0, 4.0))
+
+    delta = cm.collect_delta()
+    assert delta is not None and delta.perm is not None
+    assert delta.meta is not None
+    got_s, got_p = apply_deltas(state, placement, delta,
+                                pad_replica_updates_to=16,
+                                pad_broker_updates_to=4)
+    want_s, want_p, want_m = _freeze(cm)
+    assert_bitwise_equal((got_s, got_p), (want_s, want_p))
+    assert delta.meta.num_replicas == want_m.num_replicas
+    assert list(delta.meta.topics) == list(want_m.topics)
+
+
+def test_delta_after_delta_chain():
+    """Several consecutive deltas replayed into the same buffers stay
+    bitwise-faithful (the chain the resident service runs in steady state)."""
+    cm = tracked_cluster()
+    state, placement, _ = _freeze(cm)
+    rng = np.random.default_rng(7)
+    pairs = [(t, p) for (t, p) in cm.partitions().keys()]
+    for step in range(5):
+        t, p = pairs[int(rng.integers(len(pairs)))]
+        for r in cm.partition(t, p):
+            cm.set_replica_load(t, p, r.broker_id,
+                                rng.uniform(1.0, 50.0, size=4))
+        delta = cm.collect_delta()
+        assert delta is not None
+        state, placement = apply_deltas(state, placement, delta,
+                                        pad_replica_updates_to=8,
+                                        pad_broker_updates_to=4)
+    want_s, want_p, _ = _freeze(cm)
+    assert_bitwise_equal((state, placement), (want_s, want_p))
+
+
+def test_overflow_and_inexpressible_edits_refuse_delta():
+    cm = tracked_cluster()
+    _freeze(cm)
+    for (t, p), rs in cm.partitions().items():
+        for r in rs:
+            cm.set_replica_load(t, p, r.broker_id, det.load(1, 1, 1, 1))
+    assert cm.collect_delta(max_updates=2) is None   # overflow → full freeze
+
+    cm2 = tracked_cluster()
+    _freeze(cm2)
+    cm2.create_broker(rack="9", host="h9", broker_id=9,
+                      capacity=dict(det.BROKER_CAPACITY))
+    assert cm2.collect_delta() is None               # new broker: refreeze
+
+    cm3 = tracked_cluster()
+    assert cm3.collect_delta() is None               # never frozen
+
+
+def test_builder_from_snapshot_roundtrip():
+    cm = det.small_cluster_model()
+    frozen = cm.freeze(pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+    rebuilt = builder_from_snapshot(*frozen)
+    assert rebuilt.counts() == cm.counts()
+    again = rebuilt.freeze(pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+    assert_bitwise_equal((again[0], again[1]), (frozen[0], frozen[1]))
+
+
+# ------------------------------------------------------------ resident service
+
+
+def _pad_fn(n_r, n_b):
+    return (PAD_R, PAD_B)
+
+
+def test_resident_service_lifecycle():
+    svc = ResidentModelService()
+    cm = det.small_cluster_model()
+    full0 = svc.stats()["fullFreezes"]
+
+    s1, p1, m1 = svc.snapshot(cm, _pad_fn)
+    st = svc.stats()
+    assert st["resident"] and st["fullFreezes"] == full0 + 1
+
+    # Same version: zero-work identity return of the resident tensors.
+    s2, p2, m2 = svc.snapshot(cm, _pad_fn)
+    assert s2 is s1 and p2 is p1
+    assert svc.stats()["fullFreezes"] == full0 + 1
+
+    # A journalled edit rides the delta path (and donates the old buffers).
+    cm.set_replica_load("T1", 0, 0, det.load(9.0, 9.0, 9.0, 9.0))
+    s3, p3, m3 = svc.snapshot(cm, _pad_fn)
+    st = svc.stats()
+    assert st["deltaApplies"] >= 1 and st["fullFreezes"] == full0 + 1
+    want = cm.freeze(pad_replicas_to=PAD_R, pad_brokers_to=PAD_B)
+    assert_bitwise_equal((s3, p3), (want[0], want[1]))
+
+    # freeze() above reset the journal: invalidation forces a re-freeze.
+    svc.invalidate("test")
+    assert not svc.stats()["resident"]
+    svc.snapshot(cm, _pad_fn)
+    st = svc.stats()
+    assert st["fullFreezes"] == full0 + 2
+    assert st["invalidationReasons"].get("test") == 1
+
+
+def test_resident_bucket_change_forces_full_freeze():
+    svc = ResidentModelService()
+    cm = det.small_cluster_model()
+    buckets = {"pad": (PAD_R, PAD_B)}
+    svc.snapshot(cm, lambda r, b: buckets["pad"])
+    full = svc.stats()["fullFreezes"]
+    cm.set_replica_load("T1", 0, 0, det.load(3, 3, 3, 3))
+    buckets["pad"] = (PAD_R * 2, PAD_B)          # cluster crossed a boundary
+    s, p, m = svc.snapshot(cm, lambda r, b: buckets["pad"])
+    assert int(np.asarray(s.valid).shape[0]) == PAD_R * 2
+    assert svc.stats()["fullFreezes"] == full + 1
+
+
+def test_resident_pins_block_donation():
+    """A pinned snapshot's buffers must survive until release(): the delta
+    apply donates them, so it has to wait for the pin to drain."""
+    svc = ResidentModelService(pin_wait_s=30.0)
+    cm = det.small_cluster_model()
+    s1, p1, _ = svc.snapshot(cm, _pad_fn, pin=True)
+    cm.set_replica_load("T1", 0, 0, det.load(2.0, 2.0, 2.0, 2.0))
+
+    applied = threading.Event()
+
+    def deltaing():
+        svc.snapshot(cm, _pad_fn)
+        applied.set()
+
+    t = threading.Thread(target=deltaing)
+    t.start()
+    # While the pin is held the apply must not have run (donation would
+    # delete s1's buffers out from under the in-flight "solve").
+    assert not applied.wait(timeout=0.5)
+    assert float(np.asarray(s1.leader_load).sum()) >= 0.0   # still readable
+    svc.release()
+    assert applied.wait(timeout=10.0)
+    t.join()
+    assert svc.stats()["deltaApplies"] >= 1
+
+
+def test_resident_disabled_always_freezes():
+    svc = ResidentModelService(enabled=False)
+    cm = det.small_cluster_model()
+    s0 = svc.stats()
+    svc.snapshot(cm, _pad_fn)
+    svc.snapshot(cm, _pad_fn)
+    st = svc.stats()
+    assert st["fullFreezes"] == s0["fullFreezes"] + 2 and not st["resident"]
+    assert st["deltaApplies"] == s0["deltaApplies"]
+
+
+def test_warm_scatter_compiles_both_kernels():
+    svc = ResidentModelService()
+    svc.warm_scatter(PAD_R, PAD_B, num_disks=2)   # must not raise
+
+
+def test_delta_chain_cap_forces_refreeze():
+    svc = ResidentModelService(max_delta_chain=1)
+    cm = det.small_cluster_model()
+    svc.snapshot(cm, _pad_fn)
+    s0 = svc.stats()
+    cm.set_replica_load("T1", 0, 0, det.load(4, 4, 4, 4))
+    svc.snapshot(cm, _pad_fn)                     # chain 0 → 1: delta
+    cm.set_replica_load("T1", 0, 0, det.load(5, 5, 5, 5))
+    svc.snapshot(cm, _pad_fn)                     # chain at cap: full freeze
+    st = svc.stats()
+    assert st["deltaApplies"] == s0["deltaApplies"] + 1
+    assert st["fullFreezes"] == s0["fullFreezes"] + 1
+
+
+# ------------------------------------------------------- monitor resident path
+
+
+def test_monitor_resident_builder_fresh_then_diff():
+    from tests.test_facade import build_stack
+
+    cc, backend, _ = build_stack()
+    lm = cc.load_monitor
+    cm, fresh = lm.resident_model_builder()
+    assert fresh and cm.delta_tracking
+    cm2, fresh2 = lm.resident_model_builder()
+    assert cm2 is cm and not fresh2
+
+    # Changed workload → sparse journal on the SAME builder object.
+    cm.freeze(pad_replicas_to=64, pad_brokers_to=8)
+    cc.task_runner.sampler.mean_bytes_in *= 1.25
+    cc.task_runner.bootstrap(6_000, 12_000)
+    cm3, fresh3 = lm.resident_model_builder()
+    assert cm3 is cm and not fresh3
+    delta = cm.collect_delta()
+    assert delta is not None and delta.num_updates > 0
+
+    # Structural metadata change (new partition) → fingerprint flip → fresh.
+    from cruise_control_tpu.monitor.metadata import PartitionInfo
+    md = backend.fetch()
+    backend.partitions = list(md.partitions) + [
+        PartitionInfo("T", 99, leader=0, replicas=(0, 1), in_sync=(0,))]
+    cm4, fresh4 = lm.resident_model_builder()
+    assert fresh4 and cm4 is not cm
+    cc.shutdown()

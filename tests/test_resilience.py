@@ -504,6 +504,48 @@ def test_solver_cpu_failover_tags_degraded():
         cc._solve_with_failover(opt, None, None, None, None, None)
 
 
+def test_solver_failover_invalidates_resident_model():
+    """A device failure mid-solve must drop the resident device buffers:
+    they live on (or were produced by) the failed backend, so the CPU retry
+    rebuilds fresh tensors and later requests full-freeze instead of
+    scatter-applying into poisoned memory."""
+    from tests.test_facade import build_stack
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    cc, _, _ = build_stack()
+    cc.proposals()
+    s0 = cc.resident.stats()
+    assert s0["resident"] and s0["fullFreezes"] == 1
+
+    real = cc.optimizer.optimizations
+    calls = {"n": 0}
+
+    def flaky(state, placement, meta, options=None, model_generation=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError("DEVICE_LOST: core dumped")
+        return real(state, placement, meta, options=options,
+                    model_generation=model_generation)
+
+    cc.optimizer.optimizations = flaky
+    r = cc.rebalance(dryrun=True)
+    assert r.degraded and r.optimizer_result is not None
+    s1 = cc.resident.stats()
+    assert s1["invalidationReasons"].get("device-failover") == 1
+    # The retry's refreeze bypasses the resident cache entirely — no entry
+    # survives the failover, and no delta was applied into dead buffers.
+    assert not s1["resident"]
+    assert s1["deltaApplies"] == s0["deltaApplies"]
+
+    # The next clean request re-establishes residency via a full freeze.
+    cc.optimizer.optimizations = real
+    cc.proposals()
+    s2 = cc.resident.stats()
+    assert s2["resident"] and s2["fullFreezes"] == s0["fullFreezes"] + 1
+
+
 # ----------------------------------------------------------------- health
 
 
