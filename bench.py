@@ -57,6 +57,15 @@ internally comparable (r05-and-earlier rows were unfenced).  ``--trace``
 additionally attaches the FULL per-phase rollup (``{phase: {count,
 total_ms, mean_ms}}``) as a ``trace`` field — per-goal wall plus the
 solver's fenced ``device_ms`` attribution.
+
+``--convergence`` turns on the solver's round recorder
+(``trace.solver.rounds``) for the run and attaches a ``convergence`` field
+to every row: per-goal round-curve summaries (rounds_to_90pct,
+acceptance_rate, stall_rounds, moves_total) for each sequential solve the
+row paid for, and per-lane early-exit-round histograms for each what-if
+batch — drained per row like ``split_ms``, warmup solves included.  Note
+the recorder changes the solver's jit-cache key, so ``--convergence``
+wall-clocks are not comparable to default rows.
 """
 
 from __future__ import annotations
@@ -122,9 +131,9 @@ def _parse_only(argv):
         raw = argv[argv.index("--only") + 1]
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
-        sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace]  "
-                         "(config numbers 1-6, e.g. --only 3 or "
-                         "--only 1,5)\n")
+        sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace] "
+                         "[--convergence]  (config numbers 1-6, e.g. "
+                         "--only 3 or --only 1,5)\n")
         raise SystemExit(2)
 
 
@@ -135,6 +144,11 @@ def _enable_trace() -> None:
     itself) right before ``run``."""
     from cruise_control_tpu.obsvc.tracer import tracer
     tracer().configure(enabled=True, ring_size=64)
+    if "--convergence" in sys.argv:
+        from cruise_control_tpu.analyzer.solver import set_round_recording
+        from cruise_control_tpu.obsvc.convergence import convergence
+        set_round_recording(True)
+        convergence().configure(enabled=True, ring_size=256)
 
 
 def main() -> None:
@@ -179,8 +193,9 @@ def main() -> None:
 
     only_args = (["--only", sys.argv[sys.argv.index("--only") + 1]]
                  if only is not None else [])
-    if "--trace" in sys.argv:
-        only_args.append("--trace")     # child re-reads its own argv
+    for flag in ("--trace", "--convergence"):
+        if flag in sys.argv:
+            only_args.append(flag)      # child re-reads its own argv
     backend = select_backend()
     if backend == "tpu":
         # The tunneled TPU backend can hang MID-RUN (not just at init) — a
@@ -231,8 +246,39 @@ def _emit(metric: str, seconds: float, backend: str, **extra) -> dict:
         row["split_ms"] = _split_ms(roll)
         if "--trace" in sys.argv:
             row["trace"] = roll
+    if "--convergence" in sys.argv:
+        from cruise_control_tpu.obsvc.convergence import convergence
+        recs = convergence().drain()
+        if recs:
+            row["convergence"] = _convergence_rows(recs)
     print(json.dumps(row), flush=True)
     return row
+
+
+def _convergence_rows(recs: list) -> list:
+    """Per-row convergence attribution (``--convergence``): drained per row
+    like ``split_ms``, so each entry covers only the solves since the
+    previous row.  Sequential solves carry per-goal curve summaries; what-if
+    batches carry per-lane early-exit-round histograms ({rounds: lanes} per
+    goal — a warm-started batch should shift mass toward fewer rounds)."""
+    out = []
+    for rec in recs:
+        if rec["kind"] == "what_if":
+            hist = {}
+            for goal, lane_rounds in rec["laneRounds"].items():
+                counts: dict = {}
+                for r in lane_rounds:
+                    counts[r] = counts.get(r, 0) + 1
+                hist[goal] = {str(k): v for k, v in sorted(counts.items())}
+            out.append({"kind": "what_if", "lanes": rec["lanes"],
+                        "warm_start": rec["warmStart"],
+                        "early_exit_rounds": hist})
+        else:
+            out.append({"kind": rec["kind"],
+                        "goals": {g["goal"]:
+                                  g.get("stats", {"rounds_total": g["rounds"]})
+                                  for g in rec["goals"]}})
+    return out
 
 
 def _split_ms(roll: dict) -> dict:

@@ -36,6 +36,7 @@ from cruise_control_tpu.analyzer.solver import (
 from cruise_control_tpu.common.actions import ExecutionProposal, ProposalSummary
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
 from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_telemetry
+from cruise_control_tpu.obsvc import convergence as _convergence
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
@@ -416,6 +417,21 @@ class GoalOptimizer:
                         inf.violated_brokers_after = pinfo.violated_brokers_after
                         inf.metric_after = pinfo.metric_after
 
+        # Per-goal convergence sensors feed the history rings (and the
+        # Solver.*.rounds SLO objective) even with round recording off —
+        # final rounds/moves are free outputs of every solve.
+        for inf in infos:
+            registry().settable_gauge(
+                f"Solver.{inf.goal_name}.rounds").set(inf.rounds)
+            registry().settable_gauge(
+                f"Solver.{inf.goal_name}.moves").set(inf.moves_applied)
+        _convergence().record_solve(
+            [{"goal": inf.goal_name, "curve": inf.round_curve,
+              "metric_before": inf.metric_before, "rounds": inf.rounds,
+              "moves": inf.moves_applied} for inf in infos],
+            kind="propose",
+            attrs={"generation": model_generation})
+
         # `agg` is exact here: every solve returns a fresh full recompute and
         # the placement has not changed since the last one.
         vioN = self.solver.violations(goals, gctx, placement, agg)
@@ -592,6 +608,12 @@ class GoalOptimizer:
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
                 *[b[4] for b in blocks])
 
+        # Per-lane early-exit rounds: the batch executables never carry the
+        # round-stats buffer (vmapped buffers would dwarf the solve state),
+        # but the i32[S,G] rounds matrix they already return is exactly the
+        # per-lane early-exit story the recorder wants.
+        _convergence().record_batch([g.name for g in goals], rounds,
+                                    warm_start=warm_start is not None)
         return BatchScenarioResult(
             scenario_sets=[list(map(int, ids)) for ids in scenario_sets],
             goal_names=[g.name for g in goals],

@@ -63,6 +63,40 @@ _SCORE_FLOOR = -1e29  # candidate scores below this are "not a candidate"
 # Plain float (see leadership.py _BIG): no backend init at import.
 _INF_COST = 3.4e38
 
+# ---------------------------------------------------------------------------
+# Round-level convergence recording (trace.solver.rounds).
+#
+# When enabled, every sequential solve threads a preallocated
+# (max_rounds, ROUND_STATS_COLS) float32 buffer through the while_loop carry
+# and scatters one row per round — no host callback, fusion preserved.  The
+# flag joins the solver's jit-cache key and compilesvc bucket label, so the
+# default-off executables (and their cache keys) are byte-identical to a
+# build without the recorder.  The column layout is owned by
+# obsvc/convergence.py (dependency-free, so it can be imported here without
+# closing the solver↔obsvc cycle); _solve_body stacks its row in that order.
+
+from cruise_control_tpu.obsvc.convergence import (  # noqa: E402
+    ROUND_COL_APPLIED,
+    ROUND_COL_METRIC,
+    ROUND_COL_RESYNC,
+    ROUND_COL_STALL,
+    ROUND_COL_STRANDED,
+    ROUND_COL_VIOLATED,
+    ROUND_STATS_COLS,
+)
+
+_RECORD_ROUNDS = False
+
+
+def set_round_recording(enabled: bool) -> None:
+    """Process-wide trace.solver.rounds switch (wired by obsvc.configure)."""
+    global _RECORD_ROUNDS
+    _RECORD_ROUNDS = bool(enabled)
+
+
+def round_recording_enabled() -> bool:
+    return _RECORD_ROUNDS
+
 
 def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False,
                     force_exact=None):
@@ -109,6 +143,9 @@ class GoalOptimizationInfo:
     stranded_after: int = 0
     metric_before: float = 0.0
     metric_after: float = 0.0
+    # Per-round convergence curve, shape (rounds, ROUND_STATS_COLS) —
+    # present only when trace.solver.rounds recorded this solve.
+    round_curve: Optional[np.ndarray] = None
 
     @property
     def succeeded(self) -> bool:
@@ -1071,10 +1108,18 @@ class GoalSolver:
         work remains ∧ last round made progress ∧ round budget left.
         """
         c = self._width(goal, num_replicas_padded)
+        # trace.solver.rounds joins BOTH the cache key and the bucket label:
+        # the recording executable is a different program, and the default-off
+        # key tuple stays byte-identical to a build without the recorder.
+        rec = _RECORD_ROUNDS
         key = ("solve", goal.key(), tuple(g.key() for g in priors), c)
+        bucket = f"R{num_replicas_padded}-C{c}"
+        if rec:
+            key = key + ("rounds",)
+            bucket += "-T"
         return self._cached_executable(
-            key, f"R{num_replicas_padded}-C{c}",
-            lambda: jax.jit(self._solve_body(goal, priors, c)))
+            key, bucket,
+            lambda: jax.jit(self._solve_body(goal, priors, c, record=rec)))
 
     # Aggregates carried across rounds are re-synced from a full O(R)
     # recompute every this-many rounds, bounding incremental scatter-drift
@@ -1082,11 +1127,13 @@ class GoalSolver:
     # make redundant.
     AGG_RESYNC_ROUNDS = 4
 
-    def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int):
+    def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int,
+                    record: bool = False):
         runner = self._phases_runner(goal, priors, c)
         max_rounds = jnp.int32(self.max_rounds)
         stall_limit = jnp.int32(self.stall_limit)
         resync = jnp.int32(self.AGG_RESYNC_ROUNDS)
+        buf_rounds = self.max_rounds
         # Soft goals only: a hard goal must exhaust its round budget before
         # the hard-goal check declares failure, but a soft goal that keeps
         # applying moves without lowering its violation count or improving
@@ -1106,7 +1153,7 @@ class GoalSolver:
 
             def cond(carry):
                 (_, _, rounds, applied_last, _, violated, stranded, _,
-                 _, _, stall) = carry
+                 _, _, stall) = carry[:11]
                 work = (violated > 0) | (stranded > 0)
                 progress = (rounds == 0) | (applied_last > 0)
                 ok = work & progress & (rounds < max_rounds)
@@ -1116,15 +1163,16 @@ class GoalSolver:
 
             def body(carry):
                 (pl, agg, rounds, _, moves, _, _, _, best_work, best_metric,
-                 stall) = carry
+                 stall) = carry[:11]
                 # Stalled soft-goal rounds retry with exact top-k so a
                 # deterministic approx recall miss can't silently ride the
                 # stall cutoff into an accepted residual (see _top_candidates).
                 force = (stall > 0) if use_stall_cutoff else None
                 # Periodic re-sync of the carried aggregates (every phase
                 # keeps them incrementally exact up to float accumulation).
+                resync_now = (rounds % resync == 0) & (rounds > 0)
                 agg = jax.lax.cond(
-                    (rounds % resync == 0) & (rounds > 0),
+                    resync_now,
                     lambda _pl, _ag: compute_aggregates(gctx, _pl),
                     lambda _pl, _ag: _ag,
                     pl, agg)
@@ -1137,15 +1185,30 @@ class GoalSolver:
                 stall = jnp.where(improved, jnp.int32(0), stall + 1)
                 best_work = jnp.minimum(best_work, work_now)
                 best_metric = jnp.minimum(best_metric, metric)
-                return (pl, agg, rounds + 1, applied, moves + applied,
-                        violated, stranded, metric, best_work, best_metric,
-                        stall)
+                out = (pl, agg, rounds + 1, applied, moves + applied,
+                       violated, stranded, metric, best_work, best_metric,
+                       stall)
+                if record:
+                    # One dynamic-index scatter per round into the
+                    # preallocated stats buffer riding the carry.
+                    row = jnp.stack([
+                        applied.astype(jnp.float32),
+                        violated.astype(jnp.float32),
+                        stranded.astype(jnp.float32),
+                        metric.astype(jnp.float32),
+                        resync_now.astype(jnp.float32),
+                        stall.astype(jnp.float32)])
+                    out = out + (carry[11].at[rounds].set(row),)
+                return out
 
             init = (placement, agg0, jnp.int32(0), jnp.int32(1), jnp.int32(0),
                     violated0, stranded0, metric0,
                     violated0 + stranded0, metric0, jnp.int32(0))
-            pl, agg_c, rounds, _, moves, *_ = \
-                jax.lax.while_loop(cond, body, init)
+            if record:
+                init = init + (jnp.zeros((buf_rounds, ROUND_STATS_COLS),
+                                         jnp.float32),)
+            final = jax.lax.while_loop(cond, body, init)
+            pl, agg_c, rounds, _, moves = final[:5]
             # The RETURNED residuals are computed from one fresh recompute:
             # the in-loop values ride the carried aggregates (exact up to
             # float scatter-drift between resyncs — fine for driving the
@@ -1166,8 +1229,11 @@ class GoalSolver:
             agg_f, violated_f, stranded_f, metric_f = jax.lax.cond(
                 rounds > 0, _fresh,
                 lambda pl: (agg_c, violated0, stranded0, metric0), pl)
-            return (pl, agg_f, rounds, moves, violated_f, stranded_f, metric_f,
-                    violated0, metric0)
+            out = (pl, agg_f, rounds, moves, violated_f, stranded_f, metric_f,
+                   violated0, metric0)
+            if record:
+                out = out + (final[11],)
+            return out
 
         return solve
 
@@ -1248,7 +1314,12 @@ class GoalSolver:
         else:
             out = solve(gctx, placement, agg)
         (placement, agg, rounds, moves, violated, stranded, metric, violated0,
-         metric0) = out
+         metric0) = out[:9]
+        # With trace.solver.rounds on, the solve returned the round-stats
+        # buffer as a tenth output; slice it to the rounds actually run.
+        curve = None
+        if len(out) > 9:
+            curve = np.asarray(out[9])[:int(rounds)]
         info = GoalOptimizationInfo(
             goal_name=goal.name,
             rounds=int(rounds),
@@ -1258,6 +1329,7 @@ class GoalSolver:
             stranded_after=int(stranded),
             metric_before=float(metric0),
             metric_after=float(metric) if int(rounds) > 0 else float(metric0),
+            round_curve=curve,
         )
         return placement, agg, info
 

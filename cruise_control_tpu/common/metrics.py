@@ -116,11 +116,12 @@ class Timer:
             n = self._count
         if not vals:
             return {"count": n, "mean_ms": 0.0, "max_ms": 0.0,
-                    "p50_ms": 0.0, "p999_ms": 0.0}
+                    "p50_ms": 0.0, "p99_ms": 0.0, "p999_ms": 0.0}
         def pct(q):
             return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
         return {"count": n, "mean_ms": sum(vals) / len(vals),
-                "max_ms": vals[-1], "p50_ms": pct(0.5), "p999_ms": pct(0.999)}
+                "max_ms": vals[-1], "p50_ms": pct(0.5), "p99_ms": pct(0.99),
+                "p999_ms": pct(0.999)}
 
 
 class MetricRegistry:
@@ -241,7 +242,8 @@ class MetricRegistry:
             elif record["type"] == "timer":
                 lines.append(f"# TYPE {base} summary")
                 lines.append(f"{base}_count {record['count']}")
-                for k in ("mean_ms", "max_ms", "p50_ms", "p999_ms"):
+                for k in ("mean_ms", "max_ms", "p50_ms", "p99_ms",
+                          "p999_ms"):
                     lines.append(f"{base}_{k} {record[k]}")
             else:
                 value = record.get("value")

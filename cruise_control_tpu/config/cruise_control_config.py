@@ -304,6 +304,54 @@ def _trace_def() -> ConfigDef:
     d.define("trace.profile.dir", ConfigType.STRING, "",
              doc="root directory for POST /profile TensorBoard trace dirs; "
                  "empty = <tmpdir>/cruise_control_tpu_profiles")
+    d.define("trace.solver.rounds", ConfigType.BOOLEAN, False,
+             doc="record per-round solver convergence curves (applied moves, "
+                 "violated/stranded counts, goal metric, resync/stall flags) "
+                 "in an on-device stats buffer threaded through the solve "
+                 "loop's carry, surfaced via GET /solver_stats.  The flag "
+                 "joins the solver's jit-cache key and compilesvc bucket "
+                 "label, so the default-off executables are byte-identical "
+                 "to a build without the recorder")
+    d.define("trace.solver.ring.size", ConfigType.INT, 64, range_validator(1),
+             doc="bounded flight-recorder ring of recent per-solve "
+                 "convergence records kept for GET /solver_stats")
+    d.define("obs.history.enabled", ConfigType.BOOLEAN, True,
+             doc="run the sensor-history sampler thread: periodic "
+                 "MetricRegistry snapshots into bounded per-sensor "
+                 "time-series rings (GET /metrics/history)")
+    d.define("obs.history.interval.ms", ConfigType.LONG, 10_000,
+             range_validator(100),
+             doc="sampling cadence of the sensor-history recorder")
+    d.define("obs.history.ring.size", ConfigType.INT, 360, range_validator(1),
+             doc="samples retained per sensor (360 x 10 s default = 1 h)")
+    d.define("slo.enabled", ConfigType.BOOLEAN, False,
+             doc="evaluate the latency/solve objectives below over the "
+                 "sensor-history rings and emit SloViolationAnomaly through "
+                 "the detector -> notifier -> audit path")
+    d.define("slo.endpoint.latency.p99.ms", ConfigType.DOUBLE, 5_000.0,
+             range_validator(0.001),
+             doc="per-endpoint objective: p99 of each servlet endpoint's "
+                 "successful-request-execution-timer must stay below this")
+    d.define("slo.solve.rounds.max", ConfigType.INT, 96, range_validator(1),
+             doc="per-solve objective: a goal's convergence rounds must stay "
+                 "below this (hitting the solver's own round cap means the "
+                 "loop never converged)")
+    d.define("slo.solve.time.ms", ConfigType.DOUBLE, 30_000.0,
+             range_validator(0.001),
+             doc="per-solve objective: p99 of the proposal-computation timer")
+    d.define("slo.error.budget", ConfigType.DOUBLE, 0.1,
+             range_validator(0.0001, 1.0),
+             doc="fraction of history samples allowed to breach an objective "
+                 "before the burn rate reads 1.0")
+    d.define("slo.burn.window.short.s", ConfigType.DOUBLE, 300.0,
+             range_validator(1.0),
+             doc="short burn-rate window (both windows must burn to alert)")
+    d.define("slo.burn.window.long.s", ConfigType.DOUBLE, 3_600.0,
+             range_validator(1.0), doc="long burn-rate window")
+    d.define("slo.burn.rate.threshold", ConfigType.DOUBLE, 1.0,
+             range_validator(0.0001),
+             doc="burn rate (violating fraction / error budget) at or above "
+                 "which a window counts as burning")
     return d
 
 

@@ -14,6 +14,7 @@ from cruise_control_tpu.detector.anomalies import (
     DiskFailures,
     GoalViolations,
     MetricAnomaly,
+    SloViolationAnomaly,
     TopicAnomaly,
 )
 from cruise_control_tpu.detector.notifier import (
@@ -30,6 +31,7 @@ __all__ = [
     "BrokerFailures",
     "DiskFailures",
     "MetricAnomaly",
+    "SloViolationAnomaly",
     "TopicAnomaly",
     "AnomalyNotificationResult",
     "SelfHealingNotifier",

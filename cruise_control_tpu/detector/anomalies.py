@@ -25,6 +25,11 @@ class AnomalyType(enum.IntEnum):
     GOAL_VIOLATION = 3
     TOPIC_ANOMALY = 4
     MAINTENANCE_EVENT = 5
+    # Service-level-objective burn (no reference analog): an observability
+    # signal — a latency or solve objective burning its error budget — fed
+    # into the same detector→notifier→audit loop the reference uses for
+    # goal violations.  Lowest priority: cluster-health anomalies heal first.
+    SLO_VIOLATION = 6
 
 
 _ids = itertools.count()
@@ -142,6 +147,40 @@ class TopicAnomaly(Anomaly):
     def describe(self) -> Dict:
         d = super().describe()
         d.update({"topic": self.topic, "reason": self.reason})
+        return d
+
+
+@dataclass
+class SloViolationAnomaly(Anomaly):
+    """A service-level objective burning its error budget in BOTH burn-rate
+    windows (obsvc/slo.py evaluates the objectives over the sensor-history
+    rings).  Not self-fixable — the point is the audit/alert trail."""
+
+    objective: str = ""
+    sensor: str = ""
+    threshold: float = 0.0
+    worst_value: float = 0.0
+    burn_rate_short: float = 0.0
+    burn_rate_long: float = 0.0
+
+    def __init__(self, objective="", sensor="", threshold=0.0,
+                 worst_value=0.0, burn_rate_short=0.0, burn_rate_long=0.0,
+                 **kw):
+        super().__init__(AnomalyType.SLO_VIOLATION, **kw)
+        self.objective = objective
+        self.sensor = sensor
+        self.threshold = threshold
+        self.worst_value = worst_value
+        self.burn_rate_short = burn_rate_short
+        self.burn_rate_long = burn_rate_long
+        self.fixable = False
+
+    def describe(self) -> Dict:
+        d = super().describe()
+        d.update({"objective": self.objective, "sensor": self.sensor,
+                  "threshold": self.threshold, "worstValue": self.worst_value,
+                  "burnRateShort": self.burn_rate_short,
+                  "burnRateLong": self.burn_rate_long})
         return d
 
 

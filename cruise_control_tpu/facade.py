@@ -59,6 +59,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+from cruise_control_tpu.obsvc import convergence as _convergence
 from cruise_control_tpu.obsvc.audit import audit_log
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
@@ -108,6 +109,7 @@ class CruiseControl:
         default_completeness: Optional[ModelCompletenessRequirements] = None,
         topic_anomaly_target_rf: Optional[int] = None,
         resident_service: Optional[ResidentModelService] = None,
+        slo_detector=None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -137,6 +139,9 @@ class CruiseControl:
                 lambda: task_runner.pause_sampling("executor"),
                 lambda: task_runner.resume_sampling("executor"))
         self.topic_anomaly_target_rf = topic_anomaly_target_rf
+        # Optional SLO burn-rate detector (obsvc/slo.py), assembled by the
+        # bootstrap from slo.* keys; rides the same manager as the rest.
+        self.slo_detector = slo_detector
         self.anomaly_detector = self._build_anomaly_detector(
             self_healing_goals, anomaly_detection_interval_s)
         # Background proposal precompute (GoalOptimizer.java:137-188): a
@@ -219,11 +224,23 @@ class CruiseControl:
         self.anomaly_detector.shutdown()
         if self.task_runner is not None:
             self.task_runner.shutdown()
+        # A self-healing fix may still be executing (the detector tick that
+        # started it is fire-and-forget); stop it, or its paused-backend
+        # probe loop outlives the app and keeps failing against a peer that
+        # is being torn down with us.
+        self.executor.user_triggered_stop_execution(user=False)
         # Network-facing admin drivers (SocketClusterBackend) hold a live
         # connection; close it so embedders cycling apps don't leak sockets.
         close = getattr(self.executor.backend, "close", None)
         if close is not None:
             close()
+        # Un-publish this app's breaker: the process-global circuit outlives
+        # the app, and health() in a later-built app (tests rebuild apps
+        # in-process) would otherwise read a dead backend's OPEN state and
+        # shed its proposal traffic.
+        circuit = getattr(self.executor.backend, "circuit", None)
+        if circuit is not None and circuit is _resilience.backend_circuit():
+            _resilience.set_backend_circuit(None)
 
     def _interruptible_wait(self) -> bool:
         """True = stop.  Waits the precompute interval in <=1 s slices,
@@ -423,6 +440,8 @@ class CruiseControl:
                 target_replication_factor=self.topic_anomaly_target_rf),
             AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(),
         }
+        if self.slo_detector is not None:
+            detectors[AnomalyType.SLO_VIOLATION] = self.slo_detector
         return AnomalyDetectorManager(
             detectors, notifier=self.notifier, fixer=self._fix_anomaly,
             detection_interval_s=interval_s)
@@ -834,6 +853,7 @@ class CruiseControl:
                 "goalReadiness": [
                     {"name": g, "status": "ready"} for g in self.default_goals],
                 "residentModel": self.resident.stats(),
+                "convergence": _convergence().state_summary(),
             },
         }
 

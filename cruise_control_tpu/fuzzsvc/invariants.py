@@ -275,6 +275,47 @@ def resident_delta_equivalence(m: Materialized) -> List[str]:
     return out
 
 
+def convergence_curve_coherent(m: Materialized) -> List[str]:
+    """The trace.solver.rounds telemetry is honest on this scenario:
+    re-solving with the round recorder on must yield, per goal, a curve
+    whose length equals the reported round count, whose summed applied
+    column equals ``moves_applied``, and — for hard goals — whose violated
+    count never increases across rounds (the solver only accepts
+    non-worsening batches).  Any solver rewrite that desyncs the recorded
+    buffer from the loop it instruments fails here on every scenario kind."""
+    from cruise_control_tpu.analyzer import solver as solver_mod
+    from cruise_control_tpu.obsvc.convergence import (
+        ROUND_COL_APPLIED, ROUND_COL_VIOLATED)
+
+    prev = solver_mod.round_recording_enabled()
+    solver_mod.set_round_recording(True)
+    try:
+        res = GoalOptimizer(goal_names=list(m.scenario.goal_names)
+                            ).optimizations(m.state, m.placement, m.meta)
+    finally:
+        solver_mod.set_round_recording(prev)
+    out: List[str] = []
+    for info in res.goal_infos:
+        curve = info.round_curve
+        if curve is None:
+            out.append(f"{info.goal_name}: recorder on but no curve")
+            continue
+        arr = np.asarray(curve)
+        if len(arr) != info.rounds:
+            out.append(f"{info.goal_name}: curve length {len(arr)} != "
+                       f"reported rounds {info.rounds}")
+        applied = int(arr[:, ROUND_COL_APPLIED].sum()) if len(arr) else 0
+        if applied != info.moves_applied:
+            out.append(f"{info.goal_name}: summed per-round applied "
+                       f"{applied} != moves_applied {info.moves_applied}")
+        if goal_by_name(info.goal_name).is_hard and len(arr) >= 2:
+            viol = arr[:, ROUND_COL_VIOLATED]
+            if np.any(np.diff(viol) > 0):
+                out.append(f"{info.goal_name}: violated-broker count "
+                           f"increased mid-solve: {viol.tolist()}")
+    return out
+
+
 # --------------------------------------------------------------------------
 # kind-specific invariants
 # --------------------------------------------------------------------------
@@ -356,6 +397,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "proposals_executable": proposals_executable,
     "load_conservation": load_conservation,
     "resident_delta_equivalence": resident_delta_equivalence,
+    "convergence_curve_coherent": convergence_curve_coherent,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

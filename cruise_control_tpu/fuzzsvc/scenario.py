@@ -50,7 +50,7 @@ SMOKE_GOALS: Tuple[str, ...] = (
 BASE_INVARIANTS: Tuple[str, ...] = (
     "hard_goals_never_worsen", "soft_goals_no_regression",
     "proposals_executable", "load_conservation",
-    "resident_delta_equivalence",
+    "resident_delta_equivalence", "convergence_curve_coherent",
 )
 
 # Shared padded shapes for the smoke profile (see module docstring).

@@ -264,6 +264,16 @@ def build_app(config: CruiseControlConfig,
             **notifier_kwargs)
     else:
         notifier = SelfHealingNotifier(**notifier_kwargs)
+    slo_detector = None
+    if bool(config.get("slo.enabled")):
+        # Burn-rate SLO anomalies (obsvc/slo.py) over the sensor history
+        # rings; the detector registers under the anomaly manager like every
+        # other detector, so violations land in /state and the audit ring.
+        from cruise_control_tpu.obsvc.slo import (
+            SloViolationDetector,
+            evaluator_from_config,
+        )
+        slo_detector = SloViolationDetector(evaluator_from_config(config))
     from cruise_control_tpu.model.resident import ResidentModelService
     resident = ResidentModelService(
         enabled=bool(config["model.resident.enabled"]),
@@ -284,7 +294,8 @@ def build_app(config: CruiseControlConfig,
         topic_anomaly_target_rf=(
             int(config["topic.anomaly.target.replication.factor"])
             if config.originals.get("topic.anomaly.target.replication.factor")
-            else None))
+            else None),
+        slo_detector=slo_detector)
     maint_addr = config["maintenance.event.transport.address"]
     maint_dir = config["maintenance.event.transport.dir"]
     if maint_addr or maint_dir:

@@ -1,4 +1,5 @@
-"""Observability service: span tracing, profiler capture, self-healing audit.
+"""Observability service: span tracing, profiler capture, self-healing audit,
+solver convergence recording, sensor history rings and SLO evaluation.
 
 Public surface:
 
@@ -7,25 +8,38 @@ Public surface:
   ``trace.enabled=true``).
 * :func:`audit_log` — the bounded self-healing audit log (always on; a
   deque append per anomaly decision).
+* :func:`convergence` — the solver convergence flight recorder (per-round
+  curves; disabled until ``trace.solver.rounds=true``).
+* :func:`history` — the sensor history sampler (bounded per-sensor
+  time-series rings; on by default, ``obs.history.enabled``).
+* :mod:`~cruise_control_tpu.obsvc.slo` — burn-rate SLO evaluation over the
+  history rings, feeding ``SloViolationAnomaly`` into the detector.
 * :mod:`~cruise_control_tpu.obsvc.profiler` — ``POST /profile`` captures.
-* :func:`configure` — apply ``trace.*`` config keys at service build time.
+* :func:`configure` — apply ``trace.*`` / ``obs.*`` / ``slo.*`` config keys
+  at service build time.
 """
 
 from __future__ import annotations
 
 from cruise_control_tpu.obsvc.audit import AuditLog, audit_log
+from cruise_control_tpu.obsvc.convergence import ConvergenceRecorder, convergence
+from cruise_control_tpu.obsvc.history import HistoryRecorder, history
 from cruise_control_tpu.obsvc.tracer import Span, Tracer, tracer
 
-__all__ = ["AuditLog", "Span", "Tracer", "audit_log", "configure",
+__all__ = ["AuditLog", "ConvergenceRecorder", "HistoryRecorder", "Span",
+           "Tracer", "audit_log", "configure", "convergence", "history",
            "tracer"]
 
 
 def configure(config) -> Tracer:
-    """Wire ``trace.*`` keys into the obsvc singletons.
+    """Wire ``trace.*`` / ``obs.*`` keys into the obsvc singletons.
 
     Called from ``main.build_app`` right after the compile service is
     configured; safe to call repeatedly (tests rebuild apps in-process).
     """
+    # Lazy: solver imports obsvc.tracer mid-module, so obsvc cannot import
+    # the solver at module level without closing the cycle.
+    from cruise_control_tpu.analyzer import solver as _solver
     from cruise_control_tpu.obsvc import profiler
 
     tr = tracer()
@@ -33,4 +47,18 @@ def configure(config) -> Tracer:
                  ring_size=int(config.get("trace.ring.size")))
     audit_log().configure(maxlen=int(config.get("trace.audit.log.size")))
     profiler.configure(str(config.get("trace.profile.dir") or ""))
+
+    record_rounds = bool(config.get("trace.solver.rounds"))
+    _solver.set_round_recording(record_rounds)
+    convergence().configure(enabled=record_rounds,
+                            ring_size=int(config.get("trace.solver.ring.size")))
+
+    hist = history()
+    hist.configure(
+        interval_s=float(config.get("obs.history.interval.ms")) / 1000.0,
+        ring_size=int(config.get("obs.history.ring.size")))
+    if bool(config.get("obs.history.enabled")):
+        hist.start()
+    else:
+        hist.stop()
     return tr

@@ -54,6 +54,20 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
                 "?json=true)", [
         ("json", "boolean", "JSON snapshot instead of Prometheus text"),
     ], "VIEWER"),
+    "solver_stats": ("Solver convergence observatory: flight-recorder ring "
+                     "of per-solve per-goal round curves (applied moves, "
+                     "violated count, stranded, goal metric, resync/stall "
+                     "flags) with derived stats; per-lane early-exit rounds "
+                     "for what-if batches; empty unless trace.solver.rounds", [
+        ("limit", "integer", "return only the newest N records"),
+    ], "VIEWER"),
+    "metrics/history": ("Bounded per-sensor time-series rings sampled from "
+                        "the metric registry by the obsvc history thread "
+                        "(obs.history.*); the SLO burn-rate evaluator reads "
+                        "the same rings", [
+        ("sensor", "string", "fnmatch pattern restricting the sensors"),
+        ("since_ms", "number", "drop samples older than this epoch ms"),
+    ], "VIEWER"),
     "compile_cache": ("Compile-service state: shape-bucket policy, compiled "
                       "lane widths, persistent XLA cache, warmup progress, "
                       "per-bucket compile/hit/miss counters", [], "VIEWER"),
@@ -149,8 +163,10 @@ PROGRESS_SCHEMA = {
 
 def _component_name(endpoint: str) -> str:
     schema = schemas.ENDPOINT_SCHEMAS[endpoint]
+    # Slash endpoints (metrics/history) camel-case like underscores do.
     return _SHARED.get(id(schema)) or "".join(
-        part.capitalize() for part in endpoint.split("_")) + "Response"
+        part.capitalize()
+        for part in endpoint.replace("/", "_").split("_")) + "Response"
 
 
 def build_spec() -> Dict:
@@ -189,7 +205,7 @@ def build_spec() -> Dict:
                 "content": {"application/json": {"schema":
                             {"$ref": "#/components/schemas/AsyncProgress"}}}}
         paths[f"{API_PREFIX}/{endpoint}"] = {method: {
-            "operationId": endpoint,
+            "operationId": endpoint.replace("/", "_"),
             "summary": summary,
             "description": f"Minimum role: {role}.",
             "parameters": [

@@ -310,6 +310,77 @@ PROFILE_SCHEMA = {
     },
 }
 
+_CURVE_ROW_SCHEMA = {
+    "type": "object",
+    "required": ["applied", "violated", "stranded", "metric"],
+    "properties": {
+        "applied": {"type": "integer"},
+        "violated": {"type": "integer"},
+        "stranded": {"type": "integer"},
+        "metric": {"type": "number"},
+        "resync": {"type": "boolean"},
+        "stall": {"type": "integer"},
+    },
+}
+
+_SOLVE_RECORD_SCHEMA = {
+    "type": "object",
+    "required": ["id", "timestampMs", "kind"],
+    "properties": {
+        "id": {"type": "integer"},
+        "timestampMs": {"type": "number"},
+        "kind": {"type": "string", "enum": ["propose", "what_if"]},
+        "goals": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["goal", "rounds", "moves"],
+                "properties": {
+                    "goal": {"type": "string"},
+                    "rounds": {"type": "integer"},
+                    "moves": {"type": "integer"},
+                    "stats": {"type": "object"},
+                    "curve": {"type": "array", "items": _CURVE_ROW_SCHEMA},
+                },
+            },
+        },
+        # what_if records: per-lane early-exit rounds instead of curves.
+        "lanes": {"type": "integer"},
+        "warmStart": {"type": "boolean"},
+        "laneRounds": {"type": "object"},
+    },
+}
+
+SOLVER_STATS_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "records", "version"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "recorded": {"type": "integer"},
+        "ringSize": {"type": "integer"},
+        "records": {"type": "array", "items": _SOLVE_RECORD_SCHEMA},
+    },
+}
+
+METRICS_HISTORY_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "intervalMs", "ringSize", "series", "version"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "intervalMs": {"type": "number"},
+        "ringSize": {"type": "integer"},
+        "samples": {"type": "integer"},
+        # sensor name -> [[ts_ms, value], ...] oldest first
+        "series": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "array",
+                "items": {"type": "array", "items": {"type": "number"}},
+            },
+        },
+    },
+}
+
 _HEALTH_PROBE_SCHEMA = {
     "type": "object",
     "required": ["status"],
@@ -357,6 +428,8 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "review": REVIEW_BOARD_SCHEMA,
     "admin": ADMIN_SCHEMA,
     "metrics": METRICS_JSON_SCHEMA,
+    "metrics/history": METRICS_HISTORY_SCHEMA,
+    "solver_stats": SOLVER_STATS_SCHEMA,
     "compile_cache": COMPILE_CACHE_SCHEMA,
     "trace": TRACE_SCHEMA,
     "profile": PROFILE_SCHEMA,

@@ -46,7 +46,8 @@ _RANK = {Role.VIEWER: 0, Role.USER: 1, Role.ADMIN: 2}
 # VIEWER tier like metrics: pure observability (no cluster data beyond
 # shapes and phase timings).
 _VIEWER_GET = {"kafka_cluster_state", "user_tasks", "review_board", "metrics",
-               "compile_cache", "trace", "health"}
+               "compile_cache", "trace", "health", "solver_stats",
+               "metrics/history"}
 _ADMIN_GET = {"bootstrap", "train"}
 
 

@@ -48,7 +48,8 @@ REQUEST_ID_HEADER = "X-Request-ID"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "compile_cache", "trace", "health"}
+                 "metrics", "compile_cache", "trace", "health",
+                 "solver_stats", "metrics/history"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -228,7 +229,8 @@ class CruiseControlApp:
                              "message": "pending review"}, {}
             self.purgatory.take_approved(int(review_id))
 
-        handler = getattr(self, f"_ep_{endpoint}", None)
+        # Slash endpoints (metrics/history) dispatch to underscore methods.
+        handler = getattr(self, f"_ep_{endpoint.replace('/', '_')}", None)
         if handler is None:
             return 501, {"error": f"{endpoint} not implemented"}, {}
         # Per-endpoint servlet sensors (Sensors.md: <endpoint>-request-rate,
@@ -307,6 +309,42 @@ class CruiseControlApp:
         tr = _obsvc_tracer()
         return 200, {"enabled": tr.enabled, "traces": tr.traces(),
                      "rollup": tr.rollup()}, {}
+
+    def _ep_solver_stats(self, params, task_id):
+        """Convergence observatory: the flight-recorder ring of per-solve
+        per-goal round curves (trace.solver.rounds) plus derived stats."""
+        from cruise_control_tpu.obsvc.convergence import convergence
+        rec = convergence()
+        records = rec.records()
+        try:
+            limit = int(params.get("limit", "0"))
+        except ValueError:
+            return 400, {"error": "limit must be an integer"}, {}
+        if limit > 0:
+            records = records[-limit:]
+        summary = rec.state_summary()
+        return 200, {"enabled": summary["enabled"],
+                     "recorded": summary["recorded"],
+                     "ringSize": summary["ringSize"],
+                     "records": records}, {}
+
+    def _ep_metrics_history(self, params, task_id):
+        """Sensor time-series rings sampled by the obsvc history thread."""
+        from cruise_control_tpu.obsvc import history
+        hist = history()
+        since_raw = params.get("since_ms")
+        try:
+            since_ms = float(since_raw) if since_raw is not None else None
+        except ValueError:
+            return 400, {"error": "since_ms must be a number"}, {}
+        series = hist.history(pattern=params.get("sensor"), since_ms=since_ms)
+        from cruise_control_tpu.obsvc.history import SAMPLES_SENSOR
+        from cruise_control_tpu.common.metrics import registry
+        return 200, {"enabled": hist.running,
+                     "intervalMs": hist.interval_s * 1000.0,
+                     "ringSize": hist.ring_size,
+                     "samples": registry().counter(SAMPLES_SENSOR).count,
+                     "series": series}, {}
 
     def _ep_compile_cache(self, params, task_id):
         """Compile-service admin view: bucket policy, compiled lane widths,

@@ -12,9 +12,15 @@ and fails if either side drifted:
 - a live sensor matched by no documented row means a sensor was added
   without documenting it.
 
+docs/ENDPOINTS.md rides the same guard: every backticked token in the first
+column of its tables is a servlet route, diffed against the live dispatch
+tables (``GET_ENDPOINTS`` | ``POST_ENDPOINTS``) — a new endpoint without a
+documented row, or a documented row whose route is gone, fails the run.
+
 Run standalone (``python scripts/check_sensors.py``) or via the tier-1
 suite — tests/test_sensors.py imports ``parse_sensors_md`` / ``diff`` /
-``collect_live`` from here and asserts no drift.
+``parse_endpoints_md`` / ``endpoints_diff`` / ``collect_live`` from here
+and asserts no drift.
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SENSORS_MD = os.path.join(REPO, "docs", "SENSORS.md")
+ENDPOINTS_MD = os.path.join(REPO, "docs", "ENDPOINTS.md")
 
 _BACKTICK = re.compile(r"`([^`]+)`")
 
@@ -47,6 +54,30 @@ def parse_sensors_md(path: str = SENSORS_MD):
             if m:
                 patterns.append(m.group(1))
     return patterns
+
+
+def parse_endpoints_md(path: str = ENDPOINTS_MD):
+    """Documented endpoint routes: EVERY backticked token in the first
+    column of each table body row (a cell like ``pause_sampling /
+    resume_sampling`` documents two routes)."""
+    endpoints = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("|"):
+                continue
+            endpoints.update(_BACKTICK.findall(line.split("|")[1]))
+    return endpoints
+
+
+def endpoints_diff(documented):
+    """``(undocumented, stale)`` against the live servlet dispatch tables —
+    routes the server dispatches with no documented row, and documented
+    rows whose route the server no longer serves."""
+    from cruise_control_tpu.servlet.server import (
+        GET_ENDPOINTS, POST_ENDPOINTS)
+    live = GET_ENDPOINTS | POST_ENDPOINTS
+    return sorted(live - documented), sorted(documented - live)
 
 
 def diff(documented, live):
@@ -121,6 +152,20 @@ def main() -> int:
     documented = parse_sensors_md()
     if not documented:
         print(f"no sensor rows parsed from {SENSORS_MD}", file=sys.stderr)
+        return 1
+    doc_eps = parse_endpoints_md()
+    if not doc_eps:
+        print(f"no endpoint rows parsed from {ENDPOINTS_MD}", file=sys.stderr)
+        return 1
+    undoc_eps, stale_eps = endpoints_diff(doc_eps)
+    for e in undoc_eps:
+        print(f"SERVED BUT NOT DOCUMENTED: {e}", file=sys.stderr)
+    for e in stale_eps:
+        print(f"DOCUMENTED BUT NOT SERVED: {e}", file=sys.stderr)
+    if undoc_eps or stale_eps:
+        print(f"\nendpoint drift: {len(undoc_eps)} undocumented, "
+              f"{len(stale_eps)} stale — update docs/ENDPOINTS.md",
+              file=sys.stderr)
         return 1
     snap, _ = collect_live()
     missing, undocumented = diff(documented, set(snap))

@@ -334,3 +334,49 @@ def test_maintenance_plans_posted_from_second_process_over_tcp(tmp_path):
         assert (AnomalyType.MAINTENANCE_EVENT, "rebalance") in fixed
     finally:
         server.stop()
+
+
+def test_slo_violation_flows_to_audit_as_ignored():
+    """An SloViolationAnomaly is unfixable: the notifier must IGNORE it (no
+    fixer dispatch) and the manager must still land it in the self-healing
+    audit ring with its burn-rate detail."""
+    from cruise_control_tpu.detector.anomalies import SloViolationAnomaly
+    from cruise_control_tpu.obsvc.audit import audit_log
+
+    fixed = []
+
+    class StubSloDetector:
+        def __init__(self):
+            self.fired = False
+
+        def detect(self):
+            if self.fired:
+                return []
+            self.fired = True
+            return [SloViolationAnomaly(
+                objective="solve-time", sensor="GoalOptimizer.x",
+                threshold=100.0, worst_value=250.0,
+                burn_rate_short=3.0, burn_rate_long=2.0)]
+
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=True, clock=lambda: 1e12,
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    mgr = AnomalyDetectorManager(
+        {AnomalyType.SLO_VIOLATION: StubSloDetector()},
+        notifier=notifier,
+        fixer=lambda a: fixed.append(a.anomaly_type) or True)
+    audit_log().clear()
+    try:
+        mgr.run_detection_once()
+        assert fixed == []                      # unfixable -> never dispatched
+        entries = [e for e in audit_log().entries()
+                   if e["anomalyType"] == "SLO_VIOLATION"]
+        assert entries, audit_log().entries()
+        entry = entries[-1]
+        assert entry["decision"] == "IGNORED"
+        assert entry["description"]["objective"] == "solve-time"
+        assert entry["description"]["burnRateShort"] == 3.0
+        assert mgr.state_summary()["metrics"].get("FIX_STARTED", 0) == 0
+    finally:
+        audit_log().clear()

@@ -10,6 +10,7 @@ import urllib.request
 
 import pytest
 
+from cruise_control_tpu import resilience
 from cruise_control_tpu.obsvc.audit import AuditLog
 from cruise_control_tpu.obsvc.tracer import Tracer, tracer
 
@@ -130,6 +131,209 @@ def test_audit_set_action_targets_newest_open_entry():
     assert second["action"] == "fix_offline_replicas"
 
 
+# ------------------------------------------------- convergence recorder
+
+
+def test_convergence_recorder_ring_bounds_drain_and_disable():
+    import numpy as np
+    from cruise_control_tpu.obsvc.convergence import ConvergenceRecorder
+
+    rec = ConvergenceRecorder(enabled=True, ring_size=3)
+    curve = np.array([[2, 1, 0, 0.5, 0, 0]], dtype=np.float32)
+    for _ in range(5):
+        assert rec.record_solve(
+            [{"goal": "G", "curve": curve, "metric_before": 1.0,
+              "rounds": 1, "moves": 2}]) is not None
+    recs = rec.records()
+    assert len(recs) == 3                       # oldest two evicted
+    assert recs[0]["id"] < recs[-1]["id"]       # oldest first
+    assert recs[-1]["goals"][0]["stats"]["moves_total"] == 2
+    assert rec.state_summary()["recorded"] == 5
+    assert len(rec.drain()) == 5                # pending survives eviction
+    assert rec.drain() == []
+    assert len(rec.records()) == 3              # drain leaves the ring alone
+    rec.configure(enabled=True, ring_size=2)
+    assert len(rec.records()) == 2              # resize keeps newest
+    rec.configure(enabled=False, ring_size=2)
+    assert rec.record_solve([{"goal": "G", "rounds": 1, "moves": 0}]) is None
+    assert rec.state_summary()["recorded"] == 5
+    rec.configure(enabled=True, ring_size=4)
+    rec.record_batch(["G1", "G2"], [[3, 1], [2, 1]], warm_start=True)
+    last = rec.records()[-1]
+    assert last["kind"] == "what_if" and last["lanes"] == 2
+    assert last["warmStart"] is True
+    assert last["laneRounds"] == {"G1": [3, 2], "G2": [1, 1]}
+
+
+def test_curve_stats_derivations():
+    import numpy as np
+    from cruise_control_tpu.obsvc.convergence import (
+        ROUND_COL_APPLIED, ROUND_COL_METRIC, ROUND_COL_STALL, curve_stats)
+
+    curve = np.zeros((4, 6), dtype=np.float32)
+    curve[:, ROUND_COL_APPLIED] = [10, 5, 1, 0]
+    curve[:, ROUND_COL_METRIC] = [0.5, 0.2, 0.12, 0.1]
+    curve[3, ROUND_COL_STALL] = 1
+    s = curve_stats(curve, metric_before=1.0)
+    assert s["rounds_total"] == 4
+    assert s["moves_total"] == 16
+    assert s["acceptance_rate"] == 0.4          # 16 / (4 rounds * peak 10)
+    # 90% of the 0.9 total gain is reached at metric 0.12 — round 3.
+    assert s["rounds_to_90pct"] == 3
+    assert s["stall_rounds"] == 1
+    empty = curve_stats(np.zeros((0, 6), dtype=np.float32), 0.0)
+    assert empty["rounds_total"] == 0 and empty["acceptance_rate"] == 0.0
+
+
+def test_round_recording_off_path_cache_keys_unchanged():
+    """Acceptance: with trace.solver.rounds=false (the default) the solver
+    compiles exactly the executables it compiled before the recorder
+    existed — no 'rounds' marker in any jit-cache key, no curve on the
+    infos.  Flipping the flag adds SEPARATE keyed entries rather than
+    perturbing the off-path ones, and the curves it returns are coherent."""
+    import numpy as np
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import solver as solver_mod
+    from cruise_control_tpu.obsvc.convergence import ROUND_COL_APPLIED
+    from cruise_control_tpu.testing import deterministic as det
+
+    assert not solver_mod.round_recording_enabled()     # process default
+    state, placement, meta = det.unbalanced2().freeze(pad_replicas_to=64,
+                                                      pad_brokers_to=8)
+    # A fresh solver so the shared default_solver() cache (warm from earlier
+    # modules, possibly including recorded fuzz solves) can't mask the delta;
+    # one goal keeps the four-executable compile bill at two.
+    opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"],
+                        solver=solver_mod.GoalSolver())
+    res_off = opt.optimizations(state, placement, meta)
+    solve_keys = lambda: {k for k in opt.solver._round_cache
+                          if isinstance(k, tuple) and k and k[0] == "solve"}
+    off_keys = solve_keys()
+    assert off_keys and all("rounds" not in k for k in off_keys)
+    assert all(i.round_curve is None for i in res_off.goal_infos)
+
+    solver_mod.set_round_recording(True)
+    try:
+        res_on = opt.optimizations(state, placement, meta)
+    finally:
+        solver_mod.set_round_recording(False)
+    on_keys = solve_keys() - off_keys
+    assert on_keys and all(k[-1] == "rounds" for k in on_keys)
+    assert off_keys <= solve_keys()             # off-path entries untouched
+    checked = 0
+    for info in res_on.goal_infos:
+        curve = np.asarray(info.round_curve)
+        assert len(curve) == info.rounds
+        assert int(curve[:, ROUND_COL_APPLIED].sum()) == info.moves_applied
+        checked += info.rounds
+    assert checked > 0                          # at least one goal iterated
+
+
+# ------------------------------------------------- history rings + SLO
+
+
+def test_history_recorder_ring_bounds_and_filters(monkeypatch):
+    import importlib
+
+    from cruise_control_tpu.common.metrics import MetricRegistry
+    from cruise_control_tpu.obsvc.history import SAMPLES_SENSOR, HistoryRecorder
+
+    # The package attribute ``obsvc.history`` is the accessor function (the
+    # eager from-import shadows the submodule); patch the module itself.
+    history_mod = importlib.import_module("cruise_control_tpu.obsvc.history")
+
+    # A private registry so the sensor-doc drift guard never sees HistTest.*.
+    reg = MetricRegistry()
+    monkeypatch.setattr(history_mod, "registry", lambda: reg)
+    clock = {"now": 1000.0}
+    rec = HistoryRecorder(interval_s=3600.0, ring_size=2,
+                          clock=lambda: clock["now"])
+    reg.settable_gauge("HistTest.value").set(1.0)
+    before = reg.counter(SAMPLES_SENSOR).count
+    for _ in range(3):
+        clock["now"] += 1.0
+        rec.sample_once()
+    assert reg.counter(SAMPLES_SENSOR).count == before + 3
+    series = rec.series("HistTest.value")
+    assert len(series) == 2                     # ring bound: oldest evicted
+    assert series[0][0] < series[1][0]          # [ts_ms, value] ascending
+    assert series[-1][1] == 1.0
+    hist = rec.history(pattern="HistTest.*")
+    assert set(hist) == {"HistTest.value"}
+    assert rec.history(pattern="HistTest.*",
+                       since_ms=clock["now"] * 1000.0 + 1)["HistTest.value"] == []
+    assert not rec.running                      # sample_once needs no thread
+
+
+def _stub_history(series):
+    class _Stub:
+        def history(self, pattern=None, since_ms=None):
+            import fnmatch
+            return {k: v for k, v in series.items()
+                    if pattern is None or fnmatch.fnmatch(k, pattern)}
+    return _Stub()
+
+
+def test_slo_empty_history_is_no_verdict():
+    from cruise_control_tpu.obsvc.slo import SloEvaluator, SloObjective
+
+    obj = SloObjective(name="o", pattern="X.*", threshold=10.0)
+    ev = SloEvaluator([obj], recorder=_stub_history({}), clock=lambda: 1000.0)
+    assert ev.evaluate() == []                  # no rings at all
+    ev = SloEvaluator([obj], recorder=_stub_history({"X.a": []}),
+                      clock=lambda: 1000.0)
+    assert ev.evaluate() == []                  # an empty ring is skipped
+    # Samples entirely outside both windows: burns are None, not violating.
+    old = [[1.0, 99.0]]                         # ts 1 ms, far in the past
+    ev = SloEvaluator([obj], short_window_s=60, long_window_s=600,
+                      recorder=_stub_history({"X.a": old}),
+                      clock=lambda: 1_000_000.0)
+    (v,) = ev.evaluate()
+    assert v["burnShort"] is None and v["burnLong"] is None
+    assert v["violating"] is False
+
+
+def test_slo_clock_skew_and_both_window_gate():
+    from cruise_control_tpu.obsvc.slo import (
+        SloEvaluator, SloObjective, SloViolationDetector)
+
+    now_s = 10_000.0
+    now_ms = now_s * 1000.0
+    obj = SloObjective(name="o", pattern="X.*", threshold=10.0)
+
+    # Future-stamped samples (sampler clock ahead of the evaluator) are
+    # clamped to now and count in BOTH windows instead of being dropped.
+    future = [[now_ms + 600_000.0, 99.0]]
+    ev = SloEvaluator([obj], error_budget=0.5, short_window_s=60,
+                      long_window_s=600, recorder=_stub_history({"X.a": future}),
+                      clock=lambda: now_s)
+    (v,) = ev.evaluate()
+    assert v["violating"] is True and v["burnShort"] == 2.0
+
+    # Short window burning but the long window under threshold: de-flapped.
+    mixed = ([[now_ms - 500_000.0, 1.0]] * 8          # old, healthy
+             + [[now_ms - 1_000.0, 99.0]] * 2)        # fresh spike
+    ev = SloEvaluator([obj], error_budget=0.5, short_window_s=60,
+                      long_window_s=600, recorder=_stub_history({"X.a": mixed}),
+                      clock=lambda: now_s)
+    (v,) = ev.evaluate()
+    assert v["burnShort"] == 2.0                # 2/2 violating / 0.5 budget
+    assert v["burnLong"] == 0.4                 # 2/10 violating / 0.5 budget
+    assert v["violating"] is False
+    assert SloViolationDetector(ev).detect() == []
+
+    # Sustained burn in both windows: one anomaly, unfixable, typed.
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    bad = [[now_ms - 500_000.0, 99.0]] * 8 + [[now_ms - 1_000.0, 99.0]] * 2
+    ev = SloEvaluator([obj], error_budget=0.5, short_window_s=60,
+                      long_window_s=600, recorder=_stub_history({"X.a": bad}),
+                      clock=lambda: now_s)
+    (anomaly,) = SloViolationDetector(ev).detect()
+    assert anomaly.anomaly_type is AnomalyType.SLO_VIOLATION
+    assert anomaly.fixable is False
+    assert anomaly.describe()["sensor"] == "X.a"
+
+
 # ------------------------------------------------------------------- e2e
 
 
@@ -142,6 +346,17 @@ def _get(base, path, headers=None):
 def _post(base, path, headers=None):
     req = urllib.request.Request(base + path, headers=headers or {},
                                  method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def _get_tolerant(base, path, headers=None):
+    """GET that returns (status, body, headers) instead of raising — for
+    polling endpoints that 500 transiently while the model warms up."""
+    req = urllib.request.Request(base + path, headers=headers or {})
     try:
         with urllib.request.urlopen(req) as r:
             return r.status, r.read().decode(), dict(r.headers)
@@ -168,6 +383,10 @@ def test_trace_and_profile_endpoints_end_to_end(tmp_path):
     from cruise_control_tpu.config.cruise_control_config import (
         CruiseControlConfig)
     from cruise_control_tpu.main import build_app
+
+    # A stale OPEN breaker published by an earlier test's app would make
+    # this boot's /health shed the rebalance with a 503.
+    resilience.set_backend_circuit(None)
 
     cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
                                "partition.metrics.window.ms": 600,
@@ -201,11 +420,15 @@ def test_trace_and_profile_endpoints_end_to_end(tmp_path):
         status, body, headers = _post(
             base, f"/rebalance?dryrun=true&goals={goals}")
         task_id = headers.get(USER_TASK_HEADER)
-        while status == 202 and time.time() < deadline:
+        # 500 is retryable here: the model can be valid-windowed but not yet
+        # proposal-ready (completeness gate), which surfaces as a transient
+        # model-not-ready CruiseControlError.
+        while status in (202, 500) and time.time() < deadline:
             time.sleep(0.5)
+            hdrs = {USER_TASK_HEADER: task_id} if task_id else {}
             status, body, headers = _post(
-                base, f"/rebalance?dryrun=true&goals={goals}",
-                headers={USER_TASK_HEADER: task_id})
+                base, f"/rebalance?dryrun=true&goals={goals}", headers=hdrs)
+            task_id = headers.get(USER_TASK_HEADER) or task_id
         assert status == 200, body
 
         _, body, _ = _get(base, "/trace")
@@ -260,6 +483,111 @@ def test_trace_disabled_path_adds_no_spans():
     GoalOptimizer(goal_names=GOALS).optimizations(state, placement, meta)
     assert tr.traces() == []
     assert tr.rollup() == {}
+
+
+def test_solver_stats_and_history_endpoints_end_to_end():
+    """Acceptance: with trace.solver.rounds=true a served /proposals leaves
+    records on /solver_stats whose per-goal curve length equals the reported
+    rounds; /metrics/history serves Solver.* rings; the convergence summary
+    rides /state AnalyzerState."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig)
+    from cruise_control_tpu.main import build_app
+
+    # See test_trace_and_profile_endpoints_end_to_end: a stale published
+    # breaker must not shed this test's proposal traffic.
+    resilience.set_backend_circuit(None)
+
+    cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
+                               "partition.metrics.window.ms": 600,
+                               # One goal keeps the recording-variant compile
+                               # bill small; curves don't need a second goal.
+                               "default.goals": GOALS[:1],
+                               "trace.solver.rounds": True,
+                               "obs.history.interval.ms": 200,
+                               # Keep the detector tick out of the way — a
+                               # mid-test detection run races the /proposals
+                               # task for the optimizer.
+                               "anomaly.detection.interval.ms": 10 ** 9,
+                               "proposal.expiration.ms": 0})
+    app = build_app(cfg, port=0)
+    app.cc.start_up()
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, body, _ = _get(base, "/metrics?json=true")
+            snap = json.loads(body)["sensors"]
+            if snap.get("LoadMonitor.valid-windows", {}).get("value", 0) > 0:
+                break
+            time.sleep(0.5)
+
+        _, body, _ = _get(base, "/solver_stats")
+        pre = json.loads(body)
+        assert pre["enabled"] is True
+
+        # A valid window does not yet mean the model is proposal-ready —
+        # /proposals 500s (model-not-ready CruiseControlError) until the
+        # monitor's completeness gate opens, so retry those like a poll.
+        status, body, headers = _get_tolerant(base, "/proposals")
+        task_id = headers.get(USER_TASK_HEADER)
+        while status in (202, 500) and time.time() < deadline:
+            time.sleep(0.5)
+            hdrs = {USER_TASK_HEADER: task_id} if task_id else {}
+            status, body, headers = _get_tolerant(
+                base, "/proposals", headers=hdrs)
+            task_id = headers.get(USER_TASK_HEADER) or task_id
+        assert status == 200, body
+
+        _, body, _ = _get(base, "/solver_stats?limit=5")
+        stats = json.loads(body)
+        recs = [r for r in stats["records"] if r.get("goals")]
+        assert recs, stats
+        for g in recs[-1]["goals"]:
+            assert len(g["curve"]) == g["rounds"], g["goal"]
+            assert g["stats"]["moves_total"] == sum(
+                r["applied"] for r in g["curve"])
+
+        # History rings: the 200 ms sampler has run by now; Solver.* gauges
+        # were registered by the solve above.
+        hist_deadline = time.time() + 10
+        while time.time() < hist_deadline:
+            _, body, _ = _get(base, "/metrics/history?sensor=Solver.*")
+            hist = json.loads(body)
+            if hist["samples"] > 0 and hist["series"]:
+                break
+            time.sleep(0.3)
+        assert hist["enabled"] is True
+        assert any(k.startswith("Solver.") for k in hist["series"]), hist
+        _, body, _ = _get(base, "/metrics/history?since_ms=99999999999999")
+        future = json.loads(body)
+        assert all(len(v) == 0 for v in future["series"].values())
+
+        _, body, _ = _get(base, "/state")
+        conv = json.loads(body)["AnalyzerState"]["convergence"]
+        assert conv["enabled"] and conv["recorded"] >= 1
+        assert conv["lastSolve"] and conv["lastSolve"]["goals"]
+
+        _, body, _ = _get(base, "/metrics?json=true")
+        snap = json.loads(body)["sensors"]
+        assert "p99_ms" in snap["GoalOptimizer.proposal-computation-timer"]
+        assert snap["Obs.history-samples"]["count"] > 0
+        assert any(k.startswith("Solver.") and k.endswith(".rounds")
+                   for k in snap)
+    finally:
+        app.stop()
+        app.cc.shutdown()
+        # Hermeticity: these singletons are process-wide.
+        from cruise_control_tpu.analyzer import solver as solver_mod
+        from cruise_control_tpu.obsvc.convergence import convergence
+        from cruise_control_tpu.obsvc.history import history
+        solver_mod.set_round_recording(False)
+        convergence().configure(enabled=False, ring_size=64)
+        convergence().reset()
+        history().stop()
+        history().configure(interval_s=10.0, ring_size=360)
+        history().reset()
 
 
 def test_state_exposes_self_healing_audit():

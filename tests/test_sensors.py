@@ -193,6 +193,17 @@ def test_sensor_docs_current(service_scrape):
     assert not undocumented, f"exported but not documented: {undocumented}"
 
 
+def test_endpoint_docs_current():
+    """Fail on drift between docs/ENDPOINTS.md and the servlet dispatch
+    tables — no service boot needed, the guard diffs the route sets."""
+    mod = _check_sensors_module()
+    documented = mod.parse_endpoints_md()
+    assert documented, "docs/ENDPOINTS.md parsed to zero endpoint rows"
+    undocumented, stale = mod.endpoints_diff(documented)
+    assert not undocumented, f"served but not documented: {undocumented}"
+    assert not stale, f"documented but not served: {stale}"
+
+
 def test_optimizer_sensors():
     import numpy as np
     from cruise_control_tpu.analyzer import GoalOptimizer
