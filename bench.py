@@ -34,6 +34,12 @@ one JSON line each (headline LAST):
   identical batch ``warm_start``-ed from the already-solved base
   placement — the executable is shared (the seed placement is a traced
   input), so the pair isolates what per-lane early exit buys.
+- config #7: the anytime tradeoff — config #3's snapshot re-solved under
+  ``SolveBudget`` deadlines at 25/50/100% of the calibrated steady-state
+  (warm, unbudgeted) solve time, segmented executables pre-compiled off
+  the clock.  Each row carries ``partial`` / ``preempted_goals`` next to
+  the usual quality fields: what balancedness a fraction of the latency
+  buys, and what the segment-boundary overhead costs at 100%.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
@@ -132,7 +138,7 @@ def _parse_only(argv):
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
         sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace] "
-                         "[--convergence]  (config numbers 1-6, e.g. "
+                         "[--convergence]  (config numbers 1-7, e.g. "
                          "--only 3 or --only 1,5)\n")
         raise SystemExit(2)
 
@@ -541,6 +547,10 @@ def run(backend: str, only=None) -> None:
     if want(6):
         _delta_propose_rows(backend, lanes=64 if backend == "tpu" else 16)
 
+    # ---- config #7: the anytime quality/latency tradeoff under deadlines.
+    if want(7):
+        _deadline_rows(backend)
+
     if backend == "cpu":
         _replay_captured_tpu_rows()
 
@@ -548,6 +558,52 @@ def run(backend: str, only=None) -> None:
     if headline is not None:
         _emit("proposal_generation_wall_clock_200brokers_50k_replicas_"
               "full_goals", headline[0], backend, **headline[1])
+
+
+def _deadline_rows(backend: str) -> None:
+    """Config #7 (module docstring): the anytime solve under a wall-clock
+    budget.  Calibrate the steady-state (warm, unbudgeted) solve time on
+    the headline 200-broker/50K-replica snapshot, pre-compile the
+    segmented executables off the clock, then re-solve with deadlines at
+    25/50/100% of steady state — each row carries violated_after /
+    balancedness plus how many goals the budget preempted, so the artifact
+    shows what quality a fraction of the latency buys."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer.budget import SolveBudget
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(
+        num_brokers=200, num_racks=10, num_topics=1000,
+        num_replicas=50_000, mean_cpu=0.006, mean_disk=90.0,
+        mean_nw_in=90.0, mean_nw_out=90.0, seed=3140)
+    state, placement, meta = rc.generate(props)
+    opt = GoalOptimizer(goal_names=GOALS)
+    # Cold fused pass pays the compile; the warm repeat IS the steady state.
+    _timed(lambda: opt.optimizations(state, placement, meta))
+    steady_s, _, _ = _timed(
+        lambda: opt.optimizations(state, placement, meta))
+    # Budgeted solves dispatch the segmented executables — a parallel jit
+    # family.  Compile it off the clock with an unreachable deadline so the
+    # timed rows measure the anytime tradeoff, not XLA.
+    opt.optimizations(state, placement, meta,
+                      budget=SolveBudget(deadline_ms=1e12))
+    for frac in (0.25, 0.5, 1.0):
+        deadline_ms = steady_s * 1000.0 * frac
+        # One timed call with a FRESH budget (the clock starts at
+        # construction); everything is warm, so the wall is pure solve.
+        s, res, fresh = _timed_once(
+            lambda: opt.optimizations(
+                state, placement, meta,
+                budget=SolveBudget(deadline_ms=deadline_ms)))
+        _emit(f"anytime_deadline_{int(frac * 100)}pct_steady_state_"
+              "200brokers_50k_replicas_full_goals", s, backend,
+              deadline_ms=round(deadline_ms, 1),
+              steady_state_s=round(steady_s, 4),
+              partial=bool(res.partial),
+              preempted_goals=sum(1 for g in res.goal_infos if g.preempted),
+              **_quality(res), **_compile_fields(fresh))
+        del res
+    del state, placement, opt
 
 
 def _delta_propose_rows(backend: str, props=None, lanes: int = 16,

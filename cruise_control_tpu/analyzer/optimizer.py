@@ -86,6 +86,12 @@ class OptimizerResult:
     balancedness_score: float
     elapsed_s: float
     final_placement: Optional[Placement] = None
+    # Anytime result: the solve stopped at a budget boundary (deadline /
+    # cancellation) before every goal converged.  The placement is still
+    # feasible and hard-goal-safe for the goals that DID run — per-goal
+    # status is in goal_infos[i].preempted.
+    partial: bool = False
+    preempt_reason: Optional[str] = None
 
     @property
     def summary(self) -> ProposalSummary:
@@ -94,6 +100,8 @@ class OptimizerResult:
     def to_dict(self) -> Dict:
         s = self.summary
         return {
+            **({"partial": True, "preemptReason": self.preempt_reason}
+               if self.partial else {}),
             "numInterBrokerReplicaMovements": s.num_inter_broker_replica_movements,
             "numIntraBrokerReplicaMovements": s.num_intra_broker_replica_movements,
             "numLeaderMovements": s.num_leadership_movements,
@@ -108,6 +116,7 @@ class OptimizerResult:
             "goals": [
                 {
                     "goal": g.goal_name,
+                    "status": "preempted" if g.preempted else "completed",
                     "rounds": g.rounds,
                     "moves": g.moves_applied,
                     "violatedBrokersBefore": g.violated_brokers_before,
@@ -184,6 +193,10 @@ class BatchScenarioResult:
     rounds: np.ndarray              # i32[S, G]
     stranded_after: np.ndarray      # i32[S] offline replicas left (last goal)
     final_placements: Placement     # stacked [S, ...] pytree
+    # Budget fired between goals: goal_names (and the [S, G] stats) cover
+    # only the goal prefix that actually ran; every lane's placement is the
+    # anytime result after that prefix.
+    preempted: bool = False
 
     @property
     def num_scenarios(self) -> int:
@@ -259,6 +272,12 @@ class GoalOptimizer:
         self.polish_passes = polish_passes
         self._cache_lock = threading.Lock()
         self._cached: Dict[Tuple, OptimizerResult] = {}
+        # Materialize the preemption sensor family at 0: dashboards (and the
+        # docs/SENSORS.md drift guard) see it before the first partial solve.
+        from cruise_control_tpu.common.metrics import registry
+        for s in ("Solver.partial-solves", "Solver.preemptions",
+                  "Solver.cancellations"):
+            registry().counter(s)
 
     # ------------------------------------------------------------- the loop
 
@@ -270,17 +289,23 @@ class GoalOptimizer:
         options: Optional[OptimizationOptions] = None,
         goals: Optional[Sequence[Goal]] = None,
         model_generation: Optional[int] = None,
+        budget=None,
     ) -> OptimizerResult:
         """The core loop (GoalOptimizer.java:415-489): per-goal optimize with
-        all previously-optimized goals enforcing acceptance, then diff."""
+        all previously-optimized goals enforcing acceptance, then diff.
+
+        ``budget`` (a :class:`~cruise_control_tpu.analyzer.budget.SolveBudget`)
+        makes the run anytime: the budget is checked at every goal boundary
+        (and, when segmented, every segment boundary inside each goal); on
+        expiry/cancel the result is returned as-is with ``partial=True``."""
         tr = _obsvc_tracer()
         if not tr.enabled:
             return self._optimizations_impl(state, placement, meta, options,
-                                            goals, model_generation)
+                                            goals, model_generation, budget)
         n = len(goals) if goals is not None else len(self.goal_names)
         with tr.span("optimize", num_goals=n, generation=model_generation):
             return self._optimizations_impl(state, placement, meta, options,
-                                            goals, model_generation)
+                                            goals, model_generation, budget)
 
     def _optimizations_impl(
         self,
@@ -290,6 +315,7 @@ class GoalOptimizer:
         options: Optional[OptimizationOptions] = None,
         goals: Optional[Sequence[Goal]] = None,
         model_generation: Optional[int] = None,
+        budget=None,
     ) -> OptimizerResult:
         tr = _obsvc_tracer()
         tel = _compile_telemetry()
@@ -340,7 +366,25 @@ class GoalOptimizer:
         priors: List[Goal] = []
         agg = agg0
         bucket = f"R{gctx.state.num_replicas_padded}"
-        for goal in goals:
+        preempt_reason = None
+        for gi, goal in enumerate(goals):
+            # Goal-boundary budget check: covers cancel-only budgets (fused
+            # executables, byte-identical to budget-less) and deadlines that
+            # fire between goals.  Goals never started are recorded as
+            # preempted with zero rounds.
+            if budget is not None:
+                preempt_reason = budget.stop_reason()
+                if preempt_reason is not None:
+                    vio_rem = self.solver.violations(goals[gi:], gctx,
+                                                     placement, agg)
+                    for g, v in zip(goals[gi:], vio_rem):
+                        infos.append(GoalOptimizationInfo(
+                            goal_name=g.name,
+                            violated_brokers_before=int(v),
+                            violated_brokers_after=int(v),
+                            preempted=True,
+                            preempt_reason=preempt_reason))
+                    break
             # One span per goal per optimization round: moves + rounds from
             # the solve, compile-vs-execute split from compilesvc telemetry
             # deltas (execute_ms materializes at render time as
@@ -348,13 +392,22 @@ class GoalOptimizer:
             with tr.span(f"goal.{goal.name}", bucket=bucket) as gsp:
                 c0, s0 = tel.compile_count(), tel.compile_seconds_total()
                 placement, agg, info = self.solver.optimize_goal(
-                    goal, priors, gctx, placement, agg)
+                    goal, priors, gctx, placement, agg, budget=budget)
                 gsp.set("rounds", info.rounds)
                 gsp.set("moves", info.moves_applied)
                 gsp.set("fresh_compiles", tel.compile_count() - c0)
                 gsp.set("compile_ms", round(
                     (tel.compile_seconds_total() - s0) * 1000.0, 3))
+                if info.preempted:
+                    gsp.set("preempted", info.preempt_reason)
             infos.append(info)
+            if info.preempted:
+                # A mid-goal preemption: the placement is the best found so
+                # far.  Skip the hard-goal/no-worsen verdicts — they judge
+                # CONVERGED solves, and a partial result is allowed to carry
+                # residual violations (the caller sees partial=True).
+                preempt_reason = info.preempt_reason
+                continue
             stranded = 0
             if goal.is_hard and goal.uses_replica_moves:
                 # Goals that cannot relocate replicas across brokers (intra-disk,
@@ -381,6 +434,7 @@ class GoalOptimizer:
             priors.append(goal)
         prov_under.set(0)
         prov_right.set(1)
+        partial = any(i.preempted for i in infos)
 
         # Polish pass: a later goal's moves may RE-violate an earlier SOFT
         # goal's band (hard goals are protected by the acceptance chains).
@@ -392,7 +446,7 @@ class GoalOptimizer:
         # would pay a fresh all-but-self compile for nothing.
         satisfied_own_pass = {i.goal_name for i in infos
                               if i.violated_brokers_after == 0}
-        for _ in range(self.polish_passes):
+        for _ in range(self.polish_passes if not partial else 0):
             vioP = self.solver.violations(goals, gctx, placement, agg)
             revio = [g for g, v in zip(goals, vioP)
                      if not g.is_hard and g.name in satisfied_own_pass
@@ -429,8 +483,16 @@ class GoalOptimizer:
             [{"goal": inf.goal_name, "curve": inf.round_curve,
               "metric_before": inf.metric_before, "rounds": inf.rounds,
               "moves": inf.moves_applied} for inf in infos],
-            kind="propose",
-            attrs={"generation": model_generation})
+            kind="propose" if not partial else "propose-partial",
+            attrs={"generation": model_generation,
+                   **({"preempted": preempt_reason} if partial else {})})
+        if partial:
+            registry().counter("Solver.partial-solves").inc()
+            for inf in infos:
+                if inf.preempted:
+                    registry().counter("Solver.preemptions").inc()
+            if budget is not None and budget.cancelled():
+                registry().counter("Solver.cancellations").inc()
 
         # `agg` is exact here: every solve returns a fresh full recompute and
         # the placement has not changed since the last one.
@@ -451,11 +513,15 @@ class GoalOptimizer:
             balancedness_score=balancedness_score(infos, goals),
             elapsed_s=time.monotonic() - t0,
             final_placement=final_local,
+            partial=partial,
+            preempt_reason=preempt_reason if partial else None,
         )
         proposal_timer.update_ms(result.elapsed_s * 1000.0)
         registry().settable_gauge("AnomalyDetector.balancedness-score").set(
             result.balancedness_score)
-        if cache_key is not None:
+        # Partial results are never cached: a later request with more budget
+        # (or none) must get the converged answer, not the preempted one.
+        if cache_key is not None and not partial:
             with self._cache_lock:
                 self._cached = {cache_key: result}   # keep only latest generation
         return result
@@ -472,6 +538,7 @@ class GoalOptimizer:
         goals: Optional[Sequence[Goal]] = None,
         num_candidates: int = 512,
         warm_start: Optional[Placement] = None,
+        budget=None,
     ) -> BatchScenarioResult:
         """Solve S independent remove-broker what-ifs as ONE vmapped program
         per goal (BASELINE config #5; SURVEY §7 'jit once, vmap over
@@ -492,7 +559,7 @@ class GoalOptimizer:
         return self._batch_scenarios(state, placement, meta, removal_sets,
                                      revive=False, options=options,
                                      goals=goals, num_candidates=num_candidates,
-                                     warm_start=warm_start)
+                                     warm_start=warm_start, budget=budget)
 
     def batch_add_scenarios(
         self,
@@ -504,6 +571,7 @@ class GoalOptimizer:
         goals: Optional[Sequence[Goal]] = None,
         num_candidates: int = 512,
         warm_start: Optional[Placement] = None,
+        budget=None,
     ) -> BatchScenarioResult:
         """Add-broker what-ifs as vmapped lanes (the AddBrokersRunnable
         analog of :meth:`batch_remove_scenarios`).
@@ -516,25 +584,25 @@ class GoalOptimizer:
         return self._batch_scenarios(state, placement, meta, addition_sets,
                                      revive=True, options=options,
                                      goals=goals, num_candidates=num_candidates,
-                                     warm_start=warm_start)
+                                     warm_start=warm_start, budget=budget)
 
     def _batch_scenarios(self, state, placement, meta, scenario_sets, revive,
                          options, goals, num_candidates,
-                         warm_start=None) -> BatchScenarioResult:
+                         warm_start=None, budget=None) -> BatchScenarioResult:
         tr = _obsvc_tracer()
         if not tr.enabled:
             return self._batch_scenarios_impl(
                 state, placement, meta, scenario_sets, revive, options, goals,
-                num_candidates, warm_start)
+                num_candidates, warm_start, budget)
         with tr.span("batch_optimize", lanes=len(scenario_sets),
                      warm_start=warm_start is not None):
             return self._batch_scenarios_impl(
                 state, placement, meta, scenario_sets, revive, options, goals,
-                num_candidates, warm_start)
+                num_candidates, warm_start, budget)
 
     def _batch_scenarios_impl(self, state, placement, meta, scenario_sets,
                               revive, options, goals, num_candidates,
-                              warm_start=None) -> BatchScenarioResult:
+                              warm_start=None, budget=None) -> BatchScenarioResult:
         options = options or OptimizationOptions()
         goals = (list(goals) if goals is not None
                  else get_goals_by_priority(self.goal_names))
@@ -546,12 +614,12 @@ class GoalOptimizer:
         masks = _scenario_masks(gctx, state, meta, scenario_sets, revive=revive)
         return self._run_mask_scenarios(gctx, state, placement, goals,
                                         num_candidates, scenario_sets, *masks,
-                                        warm_start=warm_start)
+                                        warm_start=warm_start, budget=budget)
 
     def _run_mask_scenarios(self, gctx, state, placement, goals,
                             num_candidates, scenario_sets,
                             alive_s, excl_move_s, excl_lead_s,
-                            warm_start=None) -> BatchScenarioResult:
+                            warm_start=None, budget=None) -> BatchScenarioResult:
         """Shared lane runner, routed through the compile service's lane-chunk
         plan: an S-lane batch is split into blocks at already-compiled (or
         canonical-bucket) lane widths, so a 64-lane request rides the 16-lane
@@ -581,33 +649,49 @@ class GoalOptimizer:
         if plan is None or plan_is_identity(plan, s_n):
             out = self._run_lane_block(gctx, state, placement, goals,
                                        num_candidates, alive_s, excl_move_s,
-                                       excl_lead_s, warm_start=warm_start)
+                                       excl_lead_s, warm_start=warm_start,
+                                       budget=budget)
             if lane_key is not None:
                 svc.note_lanes_compiled(lane_key, s_n)
             rounds, moves, violated, stranded, placement_s = out
+            n_goals = rounds.shape[1]
         else:
             blocks = []
+            # Once the budget preempts a block mid-stack, later blocks run
+            # only the same solved-goal prefix so every block's [S, G] stats
+            # stay column-aligned (their lanes still need placements).
+            goal_limit = len(goals)
             for chunk in plan:
                 # Padding lanes re-run the last real lane; harmless work that
                 # keeps every block at a canonical compiled width.
                 idx = np.minimum(chunk.start + np.arange(chunk.size), s_n - 1)
                 out = self._run_lane_block(
-                    gctx, state, placement, goals, num_candidates,
+                    gctx, state, placement, goals[:goal_limit], num_candidates,
                     alive_s[idx], excl_move_s[idx], excl_lead_s[idx],
-                    warm_start=warm_start)
+                    warm_start=warm_start, budget=budget)
+                goal_limit = min(goal_limit, out[0].shape[1])
                 svc.note_lanes_compiled(lane_key, chunk.size)
                 n = chunk.n_real
                 blocks.append(tuple(
                     jax.tree_util.tree_map(lambda x: x[:n], part)
                     for part in out))
-            rounds = np.concatenate([b[0] for b in blocks], axis=0)
-            moves = np.concatenate([b[1] for b in blocks], axis=0)
-            violated = np.concatenate([b[2] for b in blocks], axis=0)
+            rounds = np.concatenate([b[0][:, :goal_limit] for b in blocks], axis=0)
+            moves = np.concatenate([b[1][:, :goal_limit] for b in blocks], axis=0)
+            violated = np.concatenate([b[2][:, :goal_limit] for b in blocks], axis=0)
             stranded = np.concatenate([b[3] for b in blocks], axis=0)
             placement_s = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
                 *[b[4] for b in blocks])
+            n_goals = goal_limit
 
+        preempted = n_goals < len(goals)
+        goals = goals[:n_goals]
+        if preempted:
+            from cruise_control_tpu.common.metrics import registry
+            registry().counter("Solver.preemptions").inc()
+            registry().counter("Solver.partial-solves").inc()
+            if budget is not None and budget.cancelled():
+                registry().counter("Solver.cancellations").inc()
         # Per-lane early-exit rounds: the batch executables never carry the
         # round-stats buffer (vmapped buffers would dwarf the solve state),
         # but the i32[S,G] rounds matrix they already return is exactly the
@@ -622,10 +706,12 @@ class GoalOptimizer:
             rounds=rounds,
             stranded_after=stranded,
             final_placements=placement_s,
+            preempted=preempted,
         )
 
     def _run_lane_block(self, gctx, state, placement, goals, num_candidates,
-                        alive_s, excl_move_s, excl_lead_s, warm_start=None):
+                        alive_s, excl_move_s, excl_lead_s, warm_start=None,
+                        budget=None):
         """One vmapped solve per goal over a block of lanes; returns host-local
         (rounds[S,G], moves[S,G], violated[S,G], stranded[S], placements).
 
@@ -667,6 +753,12 @@ class GoalOptimizer:
         priors: List[Goal] = []
         stranded_d = None
         for goal in goals:
+            # Goal-boundary budget check (a vmapped solve is not segmented —
+            # lanes converge independently — so the boundary between goals is
+            # the batch path's preemption seam).  At least one goal always
+            # runs so every lane has a solved placement to return.
+            if (budget is not None and priors and budget.should_stop()):
+                break
             batch = self.solver._batch_solve_fn(
                 goal, tuple(priors), state.num_replicas_padded, num_candidates)
             (placement_s, rounds_d, moves_d, violated_d, stranded_d,

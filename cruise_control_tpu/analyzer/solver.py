@@ -98,6 +98,18 @@ def round_recording_enabled() -> bool:
     return _RECORD_ROUNDS
 
 
+_SEGMENT_ROUNDS_DEFAULT = 8
+
+
+def set_default_segment_rounds(rounds: int) -> None:
+    """Process-wide solver.segment.rounds (wired by main.build_app): every
+    GoalSolver constructed without an explicit segment_rounds — including
+    the shared default_solver() and per-request custom-goal solvers — picks
+    it up.  Only budgeted (deadline-carrying) solves ever read it."""
+    global _SEGMENT_ROUNDS_DEFAULT
+    _SEGMENT_ROUNDS_DEFAULT = max(1, int(rounds))
+
+
 def _top_candidates(score: jnp.ndarray, k: int, exact: bool = False,
                     force_exact=None):
     """(values, indices) of the ~k best-scoring rows, descending.
@@ -146,6 +158,12 @@ class GoalOptimizationInfo:
     # Per-round convergence curve, shape (rounds, ROUND_STATS_COLS) —
     # present only when trace.solver.rounds recorded this solve.
     round_curve: Optional[np.ndarray] = None
+    # The solve's budget expired / was cancelled before this goal converged
+    # (anytime result: the placement is the best found so far, still
+    # feasible and prior-goal-safe — see SolveBudget).
+    preempted: bool = False
+    # Why the solve stopped early ("deadline", "cancelled", operator reason).
+    preempt_reason: Optional[str] = None
 
     @property
     def succeeded(self) -> bool:
@@ -931,11 +949,18 @@ class GoalSolver:
                  # the C×B pair matrices dominate solve cost once B is in the
                  # thousands, and band/count goals only ever send load to the
                  # top few hundred headroom brokers in one round.  0 disables.
-                 max_dst_candidates: int = 1024):
+                 max_dst_candidates: int = 1024,
+                 # Rounds per segment for budgeted (anytime) solves: smaller
+                 # segments = tighter deadline adherence, more host↔device
+                 # round-trips.  Never affects budget-less solves.  None =
+                 # the process default (solver.segment.rounds).
+                 segment_rounds: Optional[int] = None):
         self.max_candidates = max_candidates_per_round
         self.max_rounds = max_rounds_per_goal
         self.max_swap_candidates = max_swap_candidates
         self.max_dst_candidates = max_dst_candidates
+        self.segment_rounds = (segment_rounds if segment_rounds is not None
+                               else _SEGMENT_ROUNDS_DEFAULT)
         # Soft-goal churn cutoff: stop a goal's while_loop after this many
         # consecutive rounds with neither a violation-count drop nor a
         # relative stats-metric improvement (>1e-4).
@@ -1127,30 +1152,24 @@ class GoalSolver:
     # make redundant.
     AGG_RESYNC_ROUNDS = 4
 
-    def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int,
-                    record: bool = False):
+    def _loop_pieces(self, goal: Goal, priors: Tuple[Goal, ...], c: int,
+                     record: bool = False):
+        """The convergence loop's cond/body as a per-trace factory, shared by
+        the fused solve (:meth:`_solve_body`) and the segmented anytime solve
+        (:meth:`_segment_fns`) so both paths run literally the same round
+        math.  cond/body close over ``gctx``, so the factory is called inside
+        each trace."""
         runner = self._phases_runner(goal, priors, c)
         max_rounds = jnp.int32(self.max_rounds)
         stall_limit = jnp.int32(self.stall_limit)
         resync = jnp.int32(self.AGG_RESYNC_ROUNDS)
-        buf_rounds = self.max_rounds
         # Soft goals only: a hard goal must exhaust its round budget before
         # the hard-goal check declares failure, but a soft goal that keeps
         # applying moves without lowering its violation count or improving
         # its stats metric is just churning — cut the tail.
         use_stall_cutoff = not goal.is_hard
 
-        def solve(gctx: GoalContext, placement: Placement, agg0: Aggregates):
-            # agg0 is caller-supplied: between goals the placement does not
-            # change, so goal N's fresh final recompute IS goal N+1's exact
-            # starting aggregates — threading it saves one O(R) segment-sum
-            # pass per goal in the stack.
-            violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
-                                .astype(jnp.int32))
-            stranded0 = jnp.sum(currently_offline(gctx, placement)
-                                .astype(jnp.int32))
-            metric0 = goal.stats_metric(gctx, placement, agg0)
-
+        def make(gctx: GoalContext):
             def cond(carry):
                 (_, _, rounds, applied_last, _, violated, stranded, _,
                  _, _, stall) = carry[:11]
@@ -1201,14 +1220,41 @@ class GoalSolver:
                     out = out + (carry[11].at[rounds].set(row),)
                 return out
 
-            init = (placement, agg0, jnp.int32(0), jnp.int32(1), jnp.int32(0),
-                    violated0, stranded0, metric0,
-                    violated0 + stranded0, metric0, jnp.int32(0))
-            if record:
-                init = init + (jnp.zeros((buf_rounds, ROUND_STATS_COLS),
-                                         jnp.float32),)
+            return cond, body
+
+        return make
+
+    @staticmethod
+    def _loop_init(placement: Placement, agg0: Aggregates, violated0,
+                   stranded0, metric0, buf_rounds: int, record: bool):
+        """The while_loop's initial carry (shared fused/segmented)."""
+        init = (placement, agg0, jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                violated0, stranded0, metric0,
+                violated0 + stranded0, metric0, jnp.int32(0))
+        if record:
+            init = init + (jnp.zeros((buf_rounds, ROUND_STATS_COLS),
+                                     jnp.float32),)
+        return init
+
+    def _solve_body(self, goal: Goal, priors: Tuple[Goal, ...], c: int,
+                    record: bool = False):
+        make = self._loop_pieces(goal, priors, c, record)
+        buf_rounds = self.max_rounds
+
+        def solve(gctx: GoalContext, placement: Placement, agg0: Aggregates):
+            # agg0 is caller-supplied: between goals the placement does not
+            # change, so goal N's fresh final recompute IS goal N+1's exact
+            # starting aggregates — threading it saves one O(R) segment-sum
+            # pass per goal in the stack.
+            violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
+                                .astype(jnp.int32))
+            stranded0 = jnp.sum(currently_offline(gctx, placement)
+                                .astype(jnp.int32))
+            metric0 = goal.stats_metric(gctx, placement, agg0)
+            cond, body = make(gctx)
+            init = self._loop_init(placement, agg0, violated0, stranded0,
+                                   metric0, buf_rounds, record)
             final = jax.lax.while_loop(cond, body, init)
-            pl, agg_c, rounds, _, moves = final[:5]
             # The RETURNED residuals are computed from one fresh recompute:
             # the in-loop values ride the carried aggregates (exact up to
             # float scatter-drift between resyncs — fine for driving the
@@ -1217,25 +1263,102 @@ class GoalSolver:
             # satisfied goals) skip the O(R) recompute: nothing moved, so the
             # entry aggregates and residuals are still exact — this keeps a
             # satisfied goal's solve at O(B) instead of O(R).
-            def _fresh(pl):
-                agg_f = compute_aggregates(gctx, pl)
-                violated_f = jnp.sum(goal.violated_brokers(gctx, pl, agg_f)
-                                     .astype(jnp.int32))
-                stranded_f = jnp.sum(currently_offline(gctx, pl)
-                                     .astype(jnp.int32))
-                metric_f = goal.stats_metric(gctx, pl, agg_f)
-                return agg_f, violated_f, stranded_f, metric_f
-
-            agg_f, violated_f, stranded_f, metric_f = jax.lax.cond(
-                rounds > 0, _fresh,
-                lambda pl: (agg_c, violated0, stranded0, metric0), pl)
-            out = (pl, agg_f, rounds, moves, violated_f, stranded_f, metric_f,
-                   violated0, metric0)
-            if record:
-                out = out + (final[11],)
-            return out
+            return self._finalize_tail(goal, gctx, final, violated0,
+                                       stranded0, metric0, record)
 
         return solve
+
+    @staticmethod
+    def _finalize_tail(goal: Goal, gctx: GoalContext, final, violated0,
+                       stranded0, metric0, record: bool):
+        """Fresh-residual tail shared by the fused solve and the segmented
+        finalize executable (see the zero-round rationale above)."""
+        pl, agg_c, rounds, _, moves = final[:5]
+
+        def _fresh(pl):
+            agg_f = compute_aggregates(gctx, pl)
+            violated_f = jnp.sum(goal.violated_brokers(gctx, pl, agg_f)
+                                 .astype(jnp.int32))
+            stranded_f = jnp.sum(currently_offline(gctx, pl)
+                                 .astype(jnp.int32))
+            metric_f = goal.stats_metric(gctx, pl, agg_f)
+            return agg_f, violated_f, stranded_f, metric_f
+
+        agg_f, violated_f, stranded_f, metric_f = jax.lax.cond(
+            rounds > 0, _fresh,
+            lambda pl: (agg_c, violated0, stranded0, metric0), pl)
+        out = (pl, agg_f, rounds, moves, violated_f, stranded_f, metric_f,
+               violated0, metric0)
+        if record:
+            out = out + (final[11],)
+        return out
+
+    def _segment_fns(self, goal: Goal, priors: Tuple[Goal, ...],
+                     num_replicas_padded: int):
+        """(init, step, finalize) executables for the segmented anytime solve.
+
+        The fused solve is one while_loop dispatch; a budgeted solve instead
+        dispatches ``step`` repeatedly — the same cond/body (via
+        :meth:`_loop_pieces`) bounded by a TRACED segment-end round, carry
+        threaded through the host — and checks the budget between dispatches.
+        Because the round math is identical and each segment resumes from the
+        exact carry the fused loop would have had, running to convergence
+        segmented is bitwise-equal to the fused solve on a deterministic
+        backend.  ``seg_end`` is a traced int32 so one step executable serves
+        every boundary.  The cache keys/bucket get a ``segment``/``-S``
+        marker: budget-less solves never build these, keeping the default
+        path's executables and cache keys byte-identical to pre-segmentation
+        builds (same discipline as the PR 9 rounds recorder).
+        """
+        c = self._width(goal, num_replicas_padded)
+        rec = _RECORD_ROUNDS
+        base_key = ("segment", goal.key(), tuple(g.key() for g in priors), c)
+        bucket = f"R{num_replicas_padded}-C{c}-S"
+        if rec:
+            base_key = base_key + ("rounds",)
+            bucket += "-T"
+        make = self._loop_pieces(goal, priors, c, rec)
+        buf_rounds = self.max_rounds
+
+        def build_init():
+            def init_fn(gctx: GoalContext, placement: Placement,
+                        agg0: Aggregates):
+                violated0 = jnp.sum(
+                    goal.violated_brokers(gctx, placement, agg0)
+                    .astype(jnp.int32))
+                stranded0 = jnp.sum(currently_offline(gctx, placement)
+                                    .astype(jnp.int32))
+                metric0 = goal.stats_metric(gctx, placement, agg0)
+                carry = self._loop_init(placement, agg0, violated0, stranded0,
+                                        metric0, buf_rounds, rec)
+                return carry, violated0, stranded0, metric0
+            return jax.jit(init_fn)
+
+        def build_step():
+            def step_fn(gctx: GoalContext, carry, seg_end):
+                cond, body = make(gctx)
+
+                def seg_cond(cr):
+                    return cond(cr) & (cr[2] < seg_end)
+
+                out = jax.lax.while_loop(seg_cond, body, carry)
+                # done = the REAL loop condition is exhausted (converged /
+                # round budget), not merely the segment boundary.
+                return out, ~cond(out)
+            return jax.jit(step_fn)
+
+        def build_fin():
+            def fin_fn(gctx: GoalContext, carry, violated0, stranded0,
+                       metric0):
+                return self._finalize_tail(goal, gctx, carry, violated0,
+                                           stranded0, metric0, rec)
+            return jax.jit(fin_fn)
+
+        return (
+            self._cached_executable(base_key + ("init",), bucket, build_init),
+            self._cached_executable(base_key + ("step",), bucket, build_step),
+            self._cached_executable(base_key + ("fin",), bucket, build_fin),
+        )
 
     def _batch_solve_fn(self, goal: Goal, priors: Tuple[Goal, ...],
                         num_replicas_padded: int, num_candidates: int):
@@ -1285,6 +1408,7 @@ class GoalSolver:
 
     def optimize_goal(self, goal: Goal, priors: Sequence[Goal], gctx: GoalContext,
                       placement: Placement, agg: Optional[Aggregates] = None,
+                      budget=None,
                       ) -> Tuple[Placement, Aggregates, GoalOptimizationInfo]:
         """Run rounds until converged (the reference's per-goal
         ``while !finished`` loop, GoalOptimizer.java:437-462) — one device
@@ -1294,10 +1418,18 @@ class GoalSolver:
         the next goal's solve (the placement is unchanged in between); the
         returned aggregates are a fresh full recompute — or, for zero-round
         solves, the caller-supplied entry aggregates unchanged (exact either
-        way, since nothing moved)."""
-        solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
+        way, since nothing moved).
+
+        ``budget`` (a :class:`~cruise_control_tpu.analyzer.budget.SolveBudget`
+        with ``segmented`` set) routes the solve through the segmented
+        anytime path; ``None`` (or a cancel-only budget) keeps the fused
+        single-dispatch loop, byte-identical to a budget-less build."""
         if agg is None:
             agg = self.aggregates(gctx, placement)
+        if budget is not None and budget.segmented:
+            return self._optimize_goal_segmented(goal, tuple(priors), gctx,
+                                                 placement, agg, budget)
+        solve = self._solve_fn(goal, tuple(priors), gctx.state.num_replicas_padded)
         tr = _obsvc_tracer()
         if tr.enabled:
             # Fence the dispatch so device time lands on THIS span instead
@@ -1330,6 +1462,66 @@ class GoalSolver:
             metric_before=float(metric0),
             metric_after=float(metric) if int(rounds) > 0 else float(metric0),
             round_curve=curve,
+        )
+        return placement, agg, info
+
+    def _optimize_goal_segmented(self, goal: Goal, priors: Tuple[Goal, ...],
+                                 gctx: GoalContext, placement: Placement,
+                                 agg: Aggregates, budget
+                                 ) -> Tuple[Placement, Aggregates,
+                                            GoalOptimizationInfo]:
+        """Anytime convergence under a budget: dispatch fixed-round segments,
+        checking the budget at every boundary.  On expiry/cancel the current
+        carry is finalized as-is — every round's placement is feasible and
+        prior-goal-safe (acceptance-checked moves only), so the partial
+        result is always returnable."""
+        init_fn, step_fn, fin_fn = self._segment_fns(
+            goal, priors, gctx.state.num_replicas_padded)
+        seg = max(1, int(self.segment_rounds))
+        tr = _obsvc_tracer()
+        carry, violated0, stranded0, metric0 = init_fn(gctx, placement, agg)
+        stop = budget.stop_reason()
+        seg_end, seg_idx = 0, 0
+        done = False
+        while stop is None and not done:
+            seg_end = min(seg_end + seg, self.max_rounds)
+            if tr.enabled:
+                t0 = time.monotonic()
+                with tr.span("solve.segment", goal=goal.name,
+                             segment=seg_idx, seg_end=seg_end) as sp:
+                    with jax.profiler.TraceAnnotation(
+                            f"cc.solve.{goal.name}.seg{seg_idx}"):
+                        carry, done_dev = jax.block_until_ready(
+                            step_fn(gctx, carry, jnp.int32(seg_end)))
+                    done = bool(done_dev)
+                    sp.set("rounds", int(carry[2]))
+                    sp.add_ms("device_ms",
+                              round((time.monotonic() - t0) * 1000.0, 3))
+            else:
+                carry, done_dev = step_fn(gctx, carry, jnp.int32(seg_end))
+                done = bool(done_dev)  # host sync per segment by design
+            seg_idx += 1
+            if not done:
+                stop = budget.stop_reason()
+        preempted = stop is not None and not done
+        out = fin_fn(gctx, carry, violated0, stranded0, metric0)
+        (placement, agg, rounds, moves, violated, stranded, metric,
+         violated0, metric0) = out[:9]
+        curve = None
+        if len(out) > 9:
+            curve = np.asarray(out[9])[:int(rounds)]
+        info = GoalOptimizationInfo(
+            goal_name=goal.name,
+            rounds=int(rounds),
+            moves_applied=int(moves),
+            violated_brokers_before=int(violated0),
+            violated_brokers_after=int(violated),
+            stranded_after=int(stranded),
+            metric_before=float(metric0),
+            metric_after=float(metric) if int(rounds) > 0 else float(metric0),
+            round_curve=curve,
+            preempted=preempted,
+            preempt_reason=stop if preempted else None,
         )
         return placement, agg, info
 

@@ -421,6 +421,38 @@ def _resilience_def() -> ConfigDef:
     return d
 
 
+def _solver_def() -> ConfigDef:
+    """Deadline / preemption keys (no reference analog — the reference JVM
+    can interrupt its proposal thread; here the solve is a device dispatch,
+    so preemption is a first-class budget threaded through the solver's
+    segmented executables)."""
+    d = ConfigDef()
+    d.define("solver.default.deadline.ms", ConfigType.LONG, None,
+             doc="default wall-clock budget for every goal-based operation's "
+                 "solve; on expiry the solve stops at its next segment "
+                 "boundary and returns the best placement found so far, "
+                 "tagged partial.  A request's ?deadline_ms= overrides it; "
+                 "empty/0 = unbudgeted (byte-identical executables and "
+                 "results to a build without deadlines)")
+    d.define("solver.segment.rounds", ConfigType.INT, 8, range_validator(1),
+             doc="convergence rounds per segmented-solve dispatch when a "
+                 "deadline is set; smaller = tighter deadline adherence, "
+                 "more host-device round-trips.  Never affects budget-less "
+                 "solves (they run the fused single-dispatch loop)")
+    d.define("solver.shutdown.grace.ms", ConfigType.LONG, 5_000,
+             range_validator(0),
+             doc="facade.shutdown grace-drain: cancel in-flight solves and "
+                 "wait up to this long for them to unwind through their "
+                 "next segment boundary before tearing components down")
+    d.define("slo.preempt.enabled", ConfigType.BOOLEAN, False,
+             doc="escalate the solve-time SLO objective from emit-anomaly "
+                 "to actively preempting the offending in-flight solve "
+                 "(the anomaly becomes fixable and the fix cancels every "
+                 "active solve budget with reason slo-preempt).  Requires "
+                 "slo.enabled and self-healing for SLO_VIOLATION")
+    return d
+
+
 def _webserver_def() -> ConfigDef:
     d = ConfigDef()
     d.define("webserver.http.port", ConfigType.INT, 9090)
@@ -472,6 +504,12 @@ def _webserver_def() -> ConfigDef:
     d.define("max.active.user.tasks", ConfigType.INT, 25)
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG, 86_400_000)
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False)
+    d.define("servlet.user.task.timeout.ms", ConfigType.LONG, None,
+             doc="wall-clock cap on async 202 user tasks: past it the "
+                 "task's cancellation token fires (reason timeout), the "
+                 "solve stops at its next budget checkpoint, and the task "
+                 "lands in the TIMED_OUT terminal state in /user_tasks; "
+                 "empty/0 = unbounded (pre-cap behavior)")
     return d
 
 
@@ -484,7 +522,7 @@ class CruiseControlConfig:
                            .merge(_compile_def()).merge(_model_def())
                            .merge(_trace_def())
                            .merge(_fuzz_def()).merge(_resilience_def())
-                           .merge(_webserver_def()))
+                           .merge(_solver_def()).merge(_webserver_def()))
         props = dict(props or {})
         known = self.definition.keys()
         self.originals = props

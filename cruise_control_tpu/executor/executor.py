@@ -34,6 +34,7 @@ from cruise_control_tpu.executor.tasks import (
     ExecutionTaskTracker,
     TaskType,
 )
+from cruise_control_tpu.obsvc import oplog as _oplog
 from cruise_control_tpu.obsvc.audit import audit_log
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
@@ -242,6 +243,8 @@ class Executor:
         OPERATION_LOG.info(
             "execution started: %d tasks (%d proposals requested, cap %d)",
             total, len(proposals), self.config.max_num_cluster_movements)
+        _oplog.record("start", endpoint="executor.batch",
+                      tasks=total, proposals=len(proposals))
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="proposal-execution")
         self._thread.start()
@@ -469,6 +472,13 @@ class Executor:
                 counts[ExecutionTaskState.DEAD],
                 counts[ExecutionTaskState.ABORTED],
                 moved_mb)
+            _oplog.record(
+                "abort" if self._stop_requested.is_set() else "finish",
+                endpoint="executor.batch",
+                completed=counts[ExecutionTaskState.COMPLETED],
+                dead=counts[ExecutionTaskState.DEAD],
+                aborted=counts[ExecutionTaskState.ABORTED],
+                moved_mb=round(moved_mb, 1))
             span = _obsvc_tracer().current()
             if span is not None:
                 span.set("completed", counts[ExecutionTaskState.COMPLETED])

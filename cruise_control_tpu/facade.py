@@ -26,6 +26,7 @@ from cruise_control_tpu.analyzer import (
     OptimizationOptions,
     OptimizerResult,
 )
+from cruise_control_tpu.analyzer.budget import SolveBudget
 from cruise_control_tpu.common.metrics import registry as _metric_registry
 from cruise_control_tpu.analyzer.goals.registry import DEFAULT_GOALS
 from cruise_control_tpu.common.exceptions import OngoingExecutionError, UserRequestError
@@ -37,6 +38,7 @@ from cruise_control_tpu.detector.anomalies import (
     GoalViolations,
     MaintenanceEvent,
     MetricAnomaly,
+    SloViolationAnomaly,
     TopicAnomaly,
 )
 from cruise_control_tpu.detector.detectors import (
@@ -60,6 +62,7 @@ from cruise_control_tpu.monitor.load_monitor import (
 )
 from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
 from cruise_control_tpu.obsvc import convergence as _convergence
+from cruise_control_tpu.obsvc import oplog as _oplog
 from cruise_control_tpu.obsvc.audit import audit_log
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
@@ -69,6 +72,26 @@ LOG = logging.getLogger(__name__)
 # bucket policy (compilesvc.buckets.ShapeBucketPolicy) keeps them as its
 # smallest buckets, so pre-bucketing shapes stay canonical.
 PAD_R, PAD_B = 64, 8
+
+
+class _SloPreemptDetector:
+    """Wraps the SLO burn-rate detector when ``slo.preempt.enabled`` is on:
+    solve-time violations come out *fixable* so the notifier routes them to
+    the facade's fixer (which preempts the offending solve) instead of
+    IGNOREing them as audit-only."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def detect(self):
+        anomalies = self.inner.detect()
+        for a in anomalies:
+            if getattr(a, "objective", "") == "solve-time":
+                a.fixable = True
+        return anomalies
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
 
 
 @dataclass
@@ -82,11 +105,16 @@ class OperationResult:
     # True when the solve fell back to the CPU backend after a device
     # failure — the answer is correct but slower-path; operators alert on it.
     degraded: bool = False
+    # True when the solve was preempted (deadline / cancel / shutdown / SLO)
+    # and returned the best placement found so far instead of converging.
+    partial: bool = False
 
     def to_dict(self) -> Dict:
         d = {"dryrun": self.dryrun, "executed": self.executed, "info": self.info}
         if self.degraded:
             d["degraded"] = True
+        if self.partial:
+            d["partial"] = True
         if self.optimizer_result is not None:
             d["result"] = self.optimizer_result.to_dict()
         return d
@@ -110,6 +138,9 @@ class CruiseControl:
         topic_anomaly_target_rf: Optional[int] = None,
         resident_service: Optional[ResidentModelService] = None,
         slo_detector=None,
+        default_deadline_ms: Optional[float] = None,
+        shutdown_grace_ms: float = 5000.0,
+        slo_preempt_enabled: bool = False,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -139,6 +170,14 @@ class CruiseControl:
                 lambda: task_runner.pause_sampling("executor"),
                 lambda: task_runner.resume_sampling("executor"))
         self.topic_anomaly_target_rf = topic_anomaly_target_rf
+        # Deadline/cancellation plumbing (SolveBudget): every operation may
+        # carry a budget; the registry lets /cancel_user_task, the SLO
+        # escalation and shutdown's grace-drain reach in-flight solves.
+        self.default_deadline_ms = default_deadline_ms
+        self.shutdown_grace_ms = shutdown_grace_ms
+        self.slo_preempt_enabled = slo_preempt_enabled
+        self._active_budgets: Set[SolveBudget] = set()
+        self._budget_lock = threading.Lock()
         # Optional SLO burn-rate detector (obsvc/slo.py), assembled by the
         # bootstrap from slo.* keys; rides the same manager as the rest.
         self.slo_detector = slo_detector
@@ -211,6 +250,10 @@ class CruiseControl:
             LOG.exception("journal recovery failed")
 
     def shutdown(self) -> None:
+        # Grace-drain first: cancel every in-flight solve and give it one
+        # grace window to unwind through its next segment boundary, so the
+        # teardown below never yanks components out from under a dispatch.
+        self._drain_solves(self.shutdown_grace_ms)
         if self.warmup_daemon is not None:
             self.warmup_daemon.stop()
         if self.maintenance_reader is not None:
@@ -441,7 +484,13 @@ class CruiseControl:
             AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(),
         }
         if self.slo_detector is not None:
-            detectors[AnomalyType.SLO_VIOLATION] = self.slo_detector
+            slo = self.slo_detector
+            if self.slo_preempt_enabled:
+                # Escalation: a burning solve-time SLO becomes FIXABLE, and
+                # the fix is "preempt the offending solve" (the notifier
+                # IGNOREs unfixable anomalies before the fixer ever runs).
+                slo = _SloPreemptDetector(slo)
+            detectors[AnomalyType.SLO_VIOLATION] = slo
         return AnomalyDetectorManager(
             detectors, notifier=self.notifier, fixer=self._fix_anomaly,
             detection_interval_s=interval_s)
@@ -481,6 +530,67 @@ class CruiseControl:
             })
         return out
 
+    # ------------------------------------------------------ solve budgets
+
+    def _make_budget(self, deadline_ms, cancel_event) -> Optional[SolveBudget]:
+        """Build the operation's :class:`SolveBudget`, or ``None`` when no
+        deadline (request param or ``solver.default.deadline.ms``) and no
+        cancellation token apply — the ``None`` path is byte-identical to
+        the pre-budget solver."""
+        deadline = (deadline_ms if deadline_ms is not None
+                    else self.default_deadline_ms)
+        if (deadline is None or deadline <= 0) and cancel_event is None:
+            return None
+        return SolveBudget(deadline, cancel_event=cancel_event)
+
+    def _register_budget(self, budget: Optional[SolveBudget]) -> None:
+        if budget is None:
+            return
+        with self._budget_lock:
+            self._active_budgets.add(budget)
+
+    def _unregister_budget(self, budget: Optional[SolveBudget]) -> None:
+        if budget is None:
+            return
+        with self._budget_lock:
+            self._active_budgets.discard(budget)
+
+    def active_solves(self) -> int:
+        """Number of budget-carrying solves currently in flight."""
+        with self._budget_lock:
+            return len(self._active_budgets)
+
+    def cancel_active_solves(self, reason: str = "cancelled") -> int:
+        """Cancel every in-flight budget-carrying solve; returns how many
+        tokens were signalled.  Each solve stops at its next segment / goal
+        boundary and returns its current placement tagged partial."""
+        with self._budget_lock:
+            budgets = list(self._active_budgets)
+        for b in budgets:
+            b.cancel(reason)
+        if budgets:
+            LOG.info("cancelled %d in-flight solve(s): %s",
+                     len(budgets), reason)
+        return len(budgets)
+
+    def _drain_solves(self, grace_ms: float) -> bool:
+        """Grace-drain: cancel in-flight solves and wait (bounded) for them
+        to unwind through their segment boundaries.  True = drained."""
+        if not self.cancel_active_solves("shutdown"):
+            return True
+        deadline = time.monotonic() + max(0.0, grace_ms) / 1000.0
+        while time.monotonic() < deadline:
+            with self._budget_lock:
+                if not self._active_budgets:
+                    return True
+            time.sleep(0.05)
+        with self._budget_lock:
+            leftover = len(self._active_budgets)
+        if leftover:
+            LOG.warning("%d solve(s) still draining past the %.0fms grace "
+                        "budget", leftover, grace_ms)
+        return leftover == 0
+
     # ------------------------------------------------------------ operations
 
     def _run_operation(
@@ -491,17 +601,21 @@ class CruiseControl:
         model_mutator=None,
         requirements: Optional[ModelCompletenessRequirements] = None,
         use_cached: bool = False,
+        deadline_ms: Optional[float] = None,
+        cancel_event: Optional[threading.Event] = None,
     ) -> OperationResult:
         tr = _obsvc_tracer()
         if not tr.enabled:
             return self._run_operation_impl(goals, options, dryrun,
                                             model_mutator, requirements,
-                                            use_cached)
+                                            use_cached, deadline_ms,
+                                            cancel_event)
         with tr.span("operation", dryrun=dryrun,
                      num_goals=len(goals or self.default_goals)):
             return self._run_operation_impl(goals, options, dryrun,
                                             model_mutator, requirements,
-                                            use_cached)
+                                            use_cached, deadline_ms,
+                                            cancel_event)
 
     def _run_operation_impl(
         self,
@@ -511,6 +625,8 @@ class CruiseControl:
         model_mutator=None,
         requirements: Optional[ModelCompletenessRequirements] = None,
         use_cached: bool = False,
+        deadline_ms: Optional[float] = None,
+        cancel_event: Optional[threading.Event] = None,
     ) -> OperationResult:
         goals = list(goals or self.default_goals)
         if self.default_completeness is not None:
@@ -519,6 +635,8 @@ class CruiseControl:
             requirements = (self.default_completeness if requirements is None
                             else requirements.stronger(
                                 self.default_completeness))
+        budget = self._make_budget(deadline_ms, cancel_event)
+        self._register_budget(budget)
         if not dryrun:
             self.executor.set_generating_proposals_for_execution(True)
         pinned = False
@@ -550,22 +668,31 @@ class CruiseControl:
                           if use_cached and model_mutator is None else None)
             result, degraded = self._solve_with_failover(
                 optimizer, state, placement, meta, options, generation,
-                refreeze=refreeze)
-            if (model_mutator is None and not degraded
+                refreeze=refreeze, budget=budget)
+            if (model_mutator is None and not degraded and not result.partial
                     and goals == self.default_goals
                     and result.final_placement is not None):
                 # Remember the balanced answer: what-if lanes warm-start
                 # from it while the generation (and thus the shape) holds.
+                # A partial answer never seeds warm starts — it would bake
+                # an unconverged placement into every later lane.
                 self._base_solution = (self.load_monitor.model_generation,
                                        result.final_placement)
             executed = False
-            if not dryrun and result.proposals:
+            # A deadline-preempted answer is anytime-safe (every round's
+            # placement is feasible and hard-goal-safe), so it executes.
+            # Cancellation (user / SLO preempt / shutdown) means "stop",
+            # not "act on what you have" — those never execute.
+            may_execute = (not result.partial
+                           or result.preempt_reason == "deadline")
+            if not dryrun and result.proposals and may_execute:
                 self.executor.execute_proposals(result.proposals, wait=False)
                 executed = True
             elif not dryrun:
                 self.executor.set_generating_proposals_for_execution(False)
             return OperationResult(result, dryrun=dryrun, executed=executed,
-                                   degraded=degraded)
+                                   degraded=degraded,
+                                   partial=bool(result.partial))
         except Exception:
             if not dryrun:
                 try:
@@ -574,6 +701,7 @@ class CruiseControl:
                     pass
             raise
         finally:
+            self._unregister_budget(budget)
             if pinned:
                 self.resident.release()
 
@@ -611,7 +739,8 @@ class CruiseControl:
         return builder
 
     def _solve_with_failover(self, optimizer, state, placement, meta,
-                             options, generation, *, refreeze=None):
+                             options, generation, *, refreeze=None,
+                             budget=None):
         """Dispatch the solve; on device loss, fail over to the CPU backend.
 
         The accelerator can die mid-flight (preemption, driver crash, XLA
@@ -631,7 +760,7 @@ class CruiseControl:
         try:
             result = optimizer.optimizations(
                 state, placement, meta, options=options,
-                model_generation=generation)
+                model_generation=generation, budget=budget)
             self._solver_degraded_at = None
             return result, False
         except Exception as exc:  # noqa: BLE001 — classified below
@@ -649,26 +778,38 @@ class CruiseControl:
                 state, placement, meta = refreeze()
             result = optimizer.optimizations(
                 state, placement, meta, options=options,
-                model_generation=None)
+                model_generation=None, budget=budget)
         self._solver_degraded_at = time.time()
         return result, True
 
     def proposals(self, goals: Optional[Sequence[str]] = None,
-                  options: Optional[OptimizationOptions] = None) -> OperationResult:
+                  options: Optional[OptimizationOptions] = None,
+                  deadline_ms: Optional[float] = None,
+                  cancel_event: Optional[threading.Event] = None
+                  ) -> OperationResult:
         """GET /proposals — always dryrun, uses the proposal cache."""
         return self._run_operation(goals, options or OptimizationOptions(),
-                                   dryrun=True, use_cached=True)
+                                   dryrun=True, use_cached=True,
+                                   deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def rebalance(self, goals: Optional[Sequence[str]] = None,
                   dryrun: bool = True,
-                  options: Optional[OptimizationOptions] = None) -> OperationResult:
+                  options: Optional[OptimizationOptions] = None,
+                  deadline_ms: Optional[float] = None,
+                  cancel_event: Optional[threading.Event] = None
+                  ) -> OperationResult:
         """POST /rebalance (RebalanceRunnable)."""
         return self._run_operation(goals, options or OptimizationOptions(),
-                                   dryrun=dryrun)
+                                   dryrun=dryrun, deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def add_brokers(self, broker_ids: Sequence[int],
                     goals: Optional[Sequence[str]] = None,
-                    dryrun: bool = True) -> OperationResult:
+                    dryrun: bool = True,
+                    deadline_ms: Optional[float] = None,
+                    cancel_event: Optional[threading.Event] = None
+                    ) -> OperationResult:
         """POST /add_broker (AddBrokersRunnable): mark brokers as new and let
         distribution goals pull load onto them."""
         ids = set(broker_ids)
@@ -679,11 +820,16 @@ class CruiseControl:
                     b.new_broker = True
 
         return self._run_operation(goals, OptimizationOptions(), dryrun,
-                                   model_mutator=mutate)
+                                   model_mutator=mutate,
+                                   deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def remove_brokers(self, broker_ids: Sequence[int],
                        goals: Optional[Sequence[str]] = None,
-                       dryrun: bool = True) -> OperationResult:
+                       dryrun: bool = True,
+                       deadline_ms: Optional[float] = None,
+                       cancel_event: Optional[threading.Event] = None
+                       ) -> OperationResult:
         """POST /remove_broker (RemoveBrokersRunnable): decommission — mark
         dead so every goal must evacuate them, and exclude them as
         destinations."""
@@ -696,15 +842,21 @@ class CruiseControl:
         options = OptimizationOptions(
             excluded_brokers_for_replica_move=frozenset(ids),
             excluded_brokers_for_leadership=frozenset(ids))
-        return self._run_operation(goals, options, dryrun, model_mutator=mutate)
+        return self._run_operation(goals, options, dryrun, model_mutator=mutate,
+                                   deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def remove_brokers_batch(self, removal_sets: Sequence[Sequence[int]],
                              goals: Optional[Sequence[str]] = None,
-                             num_candidates: int = 512):
+                             num_candidates: int = 512,
+                             deadline_ms: Optional[float] = None,
+                             cancel_event: Optional[threading.Event] = None):
         """Batch decommission study: solve every removal set as a vmap lane of
         one compiled program (BASELINE config #5).  The reference would run
         ``RemoveBrokersRunnable`` once per set; this shares the model build
         and the per-goal compilation across all scenarios."""
+        budget = self._make_budget(deadline_ms, cancel_event)
+        self._register_budget(budget)
         pinned = False
         if self.resident.enabled:
             state, placement, meta = self._resident_snapshot()
@@ -730,27 +882,42 @@ class CruiseControl:
                 warm = base[1]
             return optimizer.batch_remove_scenarios(
                 state, placement, meta, removal_sets,
-                num_candidates=num_candidates, warm_start=warm)
+                num_candidates=num_candidates, warm_start=warm,
+                budget=budget)
         finally:
+            self._unregister_budget(budget)
             if pinned:
                 self.resident.release()
 
     def demote_brokers(self, broker_ids: Sequence[int],
-                       dryrun: bool = True) -> OperationResult:
+                       dryrun: bool = True,
+                       deadline_ms: Optional[float] = None,
+                       cancel_event: Optional[threading.Event] = None
+                       ) -> OperationResult:
         """POST /demote_broker (DemoteBrokerRunnable): move leadership off
         the brokers via preferred-leader election with them excluded."""
         options = OptimizationOptions(
             excluded_brokers_for_leadership=frozenset(broker_ids))
-        return self._run_operation(["PreferredLeaderElectionGoal"], options, dryrun)
+        return self._run_operation(["PreferredLeaderElectionGoal"], options,
+                                   dryrun, deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def fix_offline_replicas(self, goals: Optional[Sequence[str]] = None,
-                             dryrun: bool = True) -> OperationResult:
+                             dryrun: bool = True,
+                             deadline_ms: Optional[float] = None,
+                             cancel_event: Optional[threading.Event] = None
+                             ) -> OperationResult:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable)."""
-        return self._run_operation(goals, OptimizationOptions(), dryrun)
+        return self._run_operation(goals, OptimizationOptions(), dryrun,
+                                   deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def change_topic_replication_factor(self, topic: str, target_rf: int,
                                         goals: Optional[Sequence[str]] = None,
-                                        dryrun: bool = True) -> OperationResult:
+                                        dryrun: bool = True,
+                                        deadline_ms: Optional[float] = None,
+                                        cancel_event: Optional[threading.Event] = None
+                                        ) -> OperationResult:
         """POST /topic_configuration (TopicConfigurationRunnable →
         ClusterModel.createOrDeleteReplicas :962-1027)."""
 
@@ -758,7 +925,9 @@ class CruiseControl:
             cm.create_or_delete_replicas(topic, target_rf)
 
         return self._run_operation(goals, OptimizationOptions(), dryrun,
-                                   model_mutator=mutate)
+                                   model_mutator=mutate,
+                                   deadline_ms=deadline_ms,
+                                   cancel_event=cancel_event)
 
     def stop_execution(self) -> None:
         self.executor.user_triggered_stop_execution()
@@ -783,9 +952,14 @@ class CruiseControl:
     def _fix_anomaly(self, anomaly: Anomaly) -> bool:
         """Self-healing dispatch (§3.5): every fix is a normal operation."""
         # Stage 2 of the self-healing audit: annotate the detector's entry
-        # with the concrete operation chosen for this anomaly.
+        # with the concrete operation chosen for this anomaly; the chosen
+        # action also lands in the operation audit log (nobody asked for a
+        # self-healing fix, so its trail matters most).
         def note(action: str) -> None:
             audit_log().set_action(anomaly.anomaly_type.name, action)
+            _oplog.record("start", endpoint=f"self-healing:{action}",
+                          principal="self-healing",
+                          anomaly=anomaly.anomaly_type.name)
 
         try:
             if isinstance(anomaly, BrokerFailures):
@@ -813,15 +987,39 @@ class CruiseControl:
                 note("topic_replication_factor")
                 r = self.change_topic_replication_factor(
                     anomaly.topic, anomaly.target_replication_factor, dryrun=False)
+            elif isinstance(anomaly, SloViolationAnomaly):
+                # Escalated solve-time SLO burn: actively preempt the
+                # offending in-flight solve(s) via their cancellation
+                # tokens.  No proposals to execute — success is "the solve
+                # was told to stop"; each preempted operation returns its
+                # anytime-safe partial placement to its own caller.
+                if not (self.slo_preempt_enabled
+                        and anomaly.objective == "solve-time"):
+                    return False
+                note("preempt_solve")
+                preempted = self.cancel_active_solves("slo-preempt")
+                _oplog.record("preempted" if preempted else "finish",
+                              endpoint="self-healing:preempt_solve",
+                              principal="self-healing", solves=preempted)
+                return preempted > 0
             elif isinstance(anomaly, MaintenanceEvent):
                 note(f"maintenance:{anomaly.plan}")
                 r = self._run_maintenance(anomaly)
             else:
                 return False
-            return r.executed or bool(r.optimizer_result
-                                      and not r.optimizer_result.proposals)
+            ok = r.executed or bool(r.optimizer_result
+                                    and not r.optimizer_result.proposals)
+            _oplog.record("finish" if ok else "abort",
+                          endpoint=f"self-healing:{anomaly.anomaly_type.name}",
+                          principal="self-healing", executed=r.executed,
+                          partial=r.partial or None)
+            return ok
         except OngoingExecutionError:
             LOG.info("fix deferred: execution already in progress")
+            _oplog.record("abort",
+                          endpoint=f"self-healing:{anomaly.anomaly_type.name}",
+                          principal="self-healing",
+                          reason="ongoing-execution")
             return False
 
     def _run_maintenance(self, event: MaintenanceEvent) -> OperationResult:
@@ -854,6 +1052,7 @@ class CruiseControl:
                     {"name": g, "status": "ready"} for g in self.default_goals],
                 "residentModel": self.resident.stats(),
                 "convergence": _convergence().state_summary(),
+                "activeSolves": self.active_solves(),
             },
         }
 

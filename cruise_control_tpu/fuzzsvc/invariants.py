@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from cruise_control_tpu.analyzer.budget import SolveBudget
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.context import build_context, compute_aggregates
 from cruise_control_tpu.analyzer.goals.registry import goal_by_name
@@ -391,6 +392,52 @@ def chunked_parity(m: Materialized) -> List[str]:
     return out
 
 
+class _SegmentCountdown(SolveBudget):
+    """A budget that self-cancels after N ``stop_reason`` probes.
+
+    Deadlines are wall-clock and therefore irreproducible in a fuzzer; a
+    countdown preempts at an exact, seed-chosen segment/goal boundary so a
+    failing scenario replays to the same partial placement every time."""
+
+    def __init__(self, segments: int):
+        super().__init__(segmented=True)
+        self._segments_left = int(segments)
+
+    def stop_reason(self) -> Optional[str]:
+        reason = super().stop_reason()
+        if reason is not None:
+            return reason
+        self._segments_left -= 1
+        if self._segments_left <= 0:
+            self.cancel("fuzz-preempt")
+            return self.cancel_reason
+        return None
+
+
+def partial_solve_safe(m: Materialized) -> List[str]:
+    """Preempt the solve at a random segment boundary: the partial
+    placement must still satisfy every safety property the full solve
+    guarantees — no new hard-goal violations, conserved loads, and
+    executable proposals.  The anytime contract is exactly that stopping
+    early degrades *quality*, never *safety*."""
+    rng = np.random.default_rng(m.scenario.seed ^ 0xCA11)
+    budget = _SegmentCountdown(int(rng.integers(1, 7)))
+    res = GoalOptimizer(goal_names=list(m.scenario.goal_names)
+                        ).optimizations(m.state, m.placement, m.meta,
+                                        budget=budget)
+    out: List[str] = []
+    if budget.cancelled() and not res.partial:
+        out.append("budget cancelled mid-solve but result not tagged partial")
+    if res.partial and not any(i.preempted for i in res.goal_infos):
+        out.append("partial result but no goal reports preempted")
+    shadow = Materialized(m.scenario, state=m.state, placement=m.placement,
+                          meta=m.meta, _base=res)
+    for check in (hard_goals_never_worsen, load_conservation,
+                  proposals_executable):
+        out.extend(f"[partial] {d}" for d in check(shadow))
+    return out
+
+
 INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "hard_goals_never_worsen": hard_goals_never_worsen,
     "soft_goals_no_regression": soft_goals_no_regression,
@@ -398,6 +445,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "load_conservation": load_conservation,
     "resident_delta_equivalence": resident_delta_equivalence,
     "convergence_curve_coherent": convergence_curve_coherent,
+    "partial_solve_safe": partial_solve_safe,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

@@ -279,6 +279,12 @@ def build_app(config: CruiseControlConfig,
         enabled=bool(config["model.resident.enabled"]),
         max_delta_slots=int(config["model.resident.max.delta.slots"]),
         max_delta_chain=int(config["model.resident.max.delta.chain"]))
+    # Segment width for budgeted (anytime) solves: set the process default
+    # BEFORE any GoalSolver is built so the shared default_solver() and
+    # per-request custom-goal solvers all pick it up.
+    from cruise_control_tpu.analyzer.solver import set_default_segment_rounds
+    set_default_segment_rounds(int(config["solver.segment.rounds"]))
+    default_deadline = config.get("solver.default.deadline.ms")
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,
         resident_service=resident,
@@ -295,7 +301,14 @@ def build_app(config: CruiseControlConfig,
             int(config["topic.anomaly.target.replication.factor"])
             if config.originals.get("topic.anomaly.target.replication.factor")
             else None),
-        slo_detector=slo_detector)
+        slo_detector=slo_detector,
+        default_deadline_ms=(float(default_deadline)
+                             if default_deadline else None),
+        shutdown_grace_ms=float(config["solver.shutdown.grace.ms"]),
+        slo_preempt_enabled=bool(config.get("slo.preempt.enabled")))
+    # The shared solver singleton may predate this build (tests build apps
+    # in-process); align its segment width with the config too.
+    cc.optimizer.solver.segment_rounds = int(config["solver.segment.rounds"])
     maint_addr = config["maintenance.event.transport.address"]
     maint_dir = config["maintenance.event.transport.dir"]
     if maint_addr or maint_dir:
@@ -357,7 +370,10 @@ def build_app(config: CruiseControlConfig,
         ui_diskpath=config["webserver.ui.diskpath"] or None,
         ui_urlprefix=config["webserver.ui.urlprefix"],
         api_urlprefix=config["webserver.api.urlprefix"],
-        user_task_retention_ms=config["completed.user.task.retention.time.ms"])
+        user_task_retention_ms=config["completed.user.task.retention.time.ms"],
+        user_task_timeout_ms=(
+            float(config.get("servlet.user.task.timeout.ms"))
+            if config.get("servlet.user.task.timeout.ms") else None))
     return app
 
 

@@ -41,6 +41,8 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("goals", "string", "comma list of goal names"),
         ("kafka_assigner", "boolean", "assigner-mode goal pair"),
         ("excluded_topics", "string", "regex of topics to exclude"),
+        ("deadline_ms", "number", "wall-clock solve budget; on expiry the "
+         "best-so-far placement returns tagged partial"),
     ], "USER"),
     "bootstrap": ("Re-ingest historical samples", [
         ("start", "number", "range start ms"),
@@ -92,32 +94,47 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("excluded_topics", "string", "regex of topics to exclude"),
         ("only_move_immigrant_replicas", "boolean",
          "restrict to immigrant replicas"),
+        ("deadline_ms", "number", "wall-clock solve budget; on expiry the "
+         "best-so-far placement returns tagged partial"),
     ], "ADMIN"),
     "add_broker": ("Move load onto new brokers", [
         ("brokerid", "string", "comma list of broker ids"),
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
         ("throttle_added_broker", "boolean", "apply replication throttle"),
+        ("deadline_ms", "number", "wall-clock solve budget"),
     ], "ADMIN"),
     "remove_broker": ("Decommission brokers", [
         ("brokerid", "string", "comma list of broker ids"),
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
         ("destination_broker_ids", "string", "comma list of allowed targets"),
+        ("deadline_ms", "number", "wall-clock solve budget"),
     ], "ADMIN"),
     "demote_broker": ("Shed leadership from brokers", [
         ("brokerid", "string", "comma list of broker ids"),
         ("dryrun", "boolean", "propose only"),
+        ("deadline_ms", "number", "wall-clock solve budget"),
     ], "ADMIN"),
     "fix_offline_replicas": ("Re-replicate offline replicas", [
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
+        ("deadline_ms", "number", "wall-clock solve budget"),
     ], "ADMIN"),
     "topic_configuration": ("Change topic replication factor", [
         ("topic", "string", "topic regex"),
         ("replication_factor", "integer", "target RF"),
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
+        ("deadline_ms", "number", "wall-clock solve budget"),
+    ], "ADMIN"),
+    "cancel_user_task": ("Abort an in-flight 202 operation: fires its solve "
+                         "budget's cancellation token; the solve stops at "
+                         "the next segment/goal boundary and the task "
+                         "completes with its partial result (never "
+                         "executed)", [
+        ("user_task_id", "string",
+         "task to cancel (or User-Task-ID header)"),
     ], "ADMIN"),
     "stop_proposal_execution": ("Abort the in-flight execution", [], "ADMIN"),
     "pause_sampling": ("Pause metric sampling", [

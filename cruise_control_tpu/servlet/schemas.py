@@ -146,6 +146,7 @@ OPERATION_RESULT_SCHEMA = {
     "properties": {
         "dryrun": {"type": "boolean"},
         "executed": {"type": "boolean"},
+        "partial": {"type": "boolean"},
         "result": {
             "type": "object",
             "required": ["numLeaderMovements", "violatedGoalsBefore",
@@ -421,6 +422,7 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "kafka_cluster_state": KAFKA_CLUSTER_STATE_SCHEMA,
     "bootstrap": MESSAGE_SCHEMA,
     "train": TRAIN_SCHEMA,
+    "cancel_user_task": MESSAGE_SCHEMA,
     "stop_proposal_execution": MESSAGE_SCHEMA,
     "pause_sampling": MESSAGE_SCHEMA,
     "resume_sampling": MESSAGE_SCHEMA,

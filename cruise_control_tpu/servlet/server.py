@@ -37,6 +37,7 @@ from cruise_control_tpu.common.exceptions import (
 )
 from cruise_control_tpu.detector.anomalies import AnomalyType
 from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.obsvc import oplog as _oplog
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.servlet.purgatory import Purgatory
 from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
@@ -53,7 +54,7 @@ GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
-                  "topic_configuration", "profile"}
+                  "topic_configuration", "profile", "cancel_user_task"}
 # POSTs subject to two-step verification (mutating cluster state).
 REVIEWABLE = {"add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
               "demote_broker", "topic_configuration"}
@@ -123,6 +124,21 @@ def _goals(params: Dict[str, str],
     return names or None
 
 
+def _deadline_ms(params: Dict[str, str]) -> Optional[float]:
+    """``?deadline_ms=`` — wall-clock budget for this operation's solve.
+    Absent → None (the facade falls back to solver.default.deadline.ms)."""
+    raw = params.get("deadline_ms")
+    if raw is None:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise UserRequestError("deadline_ms must be a number")
+    if value <= 0:
+        raise UserRequestError("deadline_ms must be positive")
+    return value
+
+
 def _options(params: Dict[str, str]) -> OptimizationOptions:
     return OptimizationOptions(
         excluded_topics=frozenset(
@@ -147,11 +163,13 @@ class CruiseControlApp:
                  ui_diskpath: Optional[str] = None,
                  ui_urlprefix: str = "/*",
                  api_urlprefix: str = "/kafkacruisecontrol/*",
-                 user_task_retention_ms: float = 86_400_000):
+                 user_task_retention_ms: float = 86_400_000,
+                 user_task_timeout_ms: Optional[float] = None):
         self.cc = cc
         self.user_tasks = UserTaskManager(
             max_active_tasks=max_active_user_tasks,
-            completed_retention_ms=user_task_retention_ms)
+            completed_retention_ms=user_task_retention_ms,
+            task_timeout_ms=user_task_timeout_ms)
         # webserver.api.urlprefix (WebServerConfig): the mount point of the
         # REST API, normalized to a trailing-slash prefix for dispatch.  A
         # root mount ("/*" or "/") is honored — the API then owns every
@@ -438,13 +456,28 @@ class CruiseControlApp:
 
     def _async(self, endpoint: str, params: Dict[str, str], task_id: Optional[str],
                op: Callable) -> Tuple[int, Dict, Dict[str, str]]:
+        """``op`` takes the task's cancellation token (a threading.Event the
+        façade folds into the operation's SolveBudget) and returns the
+        OperationResult."""
         query = urllib.parse.urlencode(params)
-        # Snapshot this request's context (most importantly the active trace
-        # span) so the user-task worker thread parents its spans under the
-        # request's root instead of starting orphan traces.
-        ctx = contextvars.copy_context()
-        task = self.user_tasks.get_or_create(task_id, endpoint, query,
-                                             lambda progress: ctx.run(op))
+        existing = self.user_tasks.get(task_id) if task_id else None
+        if existing is not None:
+            task = existing
+        else:
+            # Snapshot this request's context (most importantly the active
+            # trace span) so the user-task worker thread parents its spans
+            # under the request's root instead of starting orphan traces.
+            ctx = contextvars.copy_context()
+            cancel_token = threading.Event()
+            task = self.user_tasks.get_or_create(
+                task_id, endpoint, query,
+                lambda progress: ctx.run(op, cancel_token),
+                cancel_token=cancel_token)
+            _oplog.record("start", task_id=task.task_id, endpoint=endpoint,
+                          params=query)
+            task.future.add_done_callback(
+                lambda f, t=task, e=endpoint, q=query, p=_oplog.current_principal():
+                self._oplog_outcome(t, e, q, p))
         headers = {USER_TASK_HEADER: task.task_id}
         if task.state is TaskState.ACTIVE:
             try:
@@ -465,62 +498,129 @@ class CruiseControlApp:
         return 200, self._render(task.future.result()), headers
 
     @staticmethod
+    def _oplog_outcome(task, endpoint: str, query: str,
+                       principal: str) -> None:
+        """Terminal oplog event for a finished user task.  Runs on the
+        worker thread via the future's done callback — the request context
+        is gone, so the captured principal is passed explicitly."""
+        try:
+            if task.future.exception() is not None:
+                _oplog.record("abort", task_id=task.task_id,
+                              endpoint=endpoint, params=query,
+                              principal=principal,
+                              reason=type(task.future.exception()).__name__)
+                return
+            result = task.future.result()
+            if getattr(result, "partial", False):
+                _oplog.record("preempted", task_id=task.task_id,
+                              endpoint=endpoint, params=query,
+                              principal=principal,
+                              reason=task.cancel_reason or "deadline",
+                              executed=getattr(result, "executed", None))
+            else:
+                _oplog.record("finish", task_id=task.task_id,
+                              endpoint=endpoint, params=query,
+                              principal=principal,
+                              executed=getattr(result, "executed", None))
+        except Exception:   # noqa: BLE001 — audit must never break a task
+            LOG.exception("operation log emit failed")
+
+    @staticmethod
     def _render(result) -> Dict:
         return result.to_dict() if hasattr(result, "to_dict") else {"result": result}
 
     def _ep_proposals(self, params, task_id):
         goals = _goals(params)
         options = _options(params)
+        dl = _deadline_ms(params)
         return self._async("proposals", params, task_id,
-                           lambda: self.cc.proposals(goals, options))
+                           lambda ev: self.cc.proposals(
+                               goals, options, deadline_ms=dl,
+                               cancel_event=ev))
 
     def _ep_rebalance(self, params, task_id):
         goals = _goals(params, allow_rebalance_disk=True)
         dryrun = _bool(params, "dryrun", True)
         options = _options(params)
+        dl = _deadline_ms(params)
         return self._async("rebalance", params, task_id,
-                           lambda: self.cc.rebalance(goals, dryrun, options))
+                           lambda ev: self.cc.rebalance(
+                               goals, dryrun, options, deadline_ms=dl,
+                               cancel_event=ev))
 
     def _ep_add_broker(self, params, task_id):
         ids = _ints(params, "brokerid")
         if not ids:
             return 400, {"error": "brokerid parameter required"}, {}
+        dl = _deadline_ms(params)
         return self._async("add_broker", params, task_id,
-                           lambda: self.cc.add_brokers(
-                               ids, _goals(params), _bool(params, "dryrun", True)))
+                           lambda ev: self.cc.add_brokers(
+                               ids, _goals(params), _bool(params, "dryrun", True),
+                               deadline_ms=dl, cancel_event=ev))
 
     def _ep_remove_broker(self, params, task_id):
         ids = _ints(params, "brokerid")
         if not ids:
             return 400, {"error": "brokerid parameter required"}, {}
+        dl = _deadline_ms(params)
         return self._async("remove_broker", params, task_id,
-                           lambda: self.cc.remove_brokers(
-                               ids, _goals(params), _bool(params, "dryrun", True)))
+                           lambda ev: self.cc.remove_brokers(
+                               ids, _goals(params), _bool(params, "dryrun", True),
+                               deadline_ms=dl, cancel_event=ev))
 
     def _ep_demote_broker(self, params, task_id):
         ids = _ints(params, "brokerid")
         if not ids:
             return 400, {"error": "brokerid parameter required"}, {}
+        dl = _deadline_ms(params)
         return self._async("demote_broker", params, task_id,
-                           lambda: self.cc.demote_brokers(
-                               ids, _bool(params, "dryrun", True)))
+                           lambda ev: self.cc.demote_brokers(
+                               ids, _bool(params, "dryrun", True),
+                               deadline_ms=dl, cancel_event=ev))
 
     def _ep_fix_offline_replicas(self, params, task_id):
+        dl = _deadline_ms(params)
         return self._async("fix_offline_replicas", params, task_id,
-                           lambda: self.cc.fix_offline_replicas(
-                               _goals(params), _bool(params, "dryrun", True)))
+                           lambda ev: self.cc.fix_offline_replicas(
+                               _goals(params), _bool(params, "dryrun", True),
+                               deadline_ms=dl, cancel_event=ev))
 
     def _ep_topic_configuration(self, params, task_id):
         topic = params.get("topic")
         rf = params.get("replication_factor")
         if not topic or rf is None:
             return 400, {"error": "topic and replication_factor required"}, {}
+        dl = _deadline_ms(params)
         return self._async("topic_configuration", params, task_id,
-                           lambda: self.cc.change_topic_replication_factor(
+                           lambda ev: self.cc.change_topic_replication_factor(
                                topic, int(rf), _goals(params),
-                               _bool(params, "dryrun", True)))
+                               _bool(params, "dryrun", True),
+                               deadline_ms=dl, cancel_event=ev))
 
     # ---- sync POSTs
+
+    def _ep_cancel_user_task(self, params, task_id):
+        """POST /cancel_user_task — abort an in-flight 202 operation at its
+        next budget checkpoint (segment or goal boundary).  The task then
+        completes with its anytime-safe partial result, never executed."""
+        tid = params.get("user_task_id") or task_id
+        if not tid:
+            return 400, {"error": "user_task_id parameter (or User-Task-ID "
+                                  "header) required"}, {}
+        task = self.user_tasks.get(tid)
+        if task is None:
+            return 404, {"error": f"unknown user task {tid}"}, {}
+        if task.state is not TaskState.ACTIVE:
+            return 400, {"error": f"task {tid} is not active "
+                                  f"({task.state.value})"}, {}
+        if not task.cancel("user"):
+            return 400, {"error": f"task {tid} carries no cancellation "
+                                  "token"}, {}
+        _oplog.record("abort", task_id=tid, endpoint=task.endpoint,
+                      params=task.query, reason="user-cancel-requested")
+        return 200, {"message": "cancellation requested; the operation "
+                                "stops at its next segment boundary",
+                     "UserTaskId": tid}, {USER_TASK_HEADER: tid}
 
     def _ep_stop_proposal_execution(self, params, task_id):
         self.cc.stop_execution()
@@ -604,6 +704,9 @@ def _make_handler(app: CruiseControlApp):
                 principal = self._authenticate_or_401()
                 if principal is None:
                     return
+                # Bind the authenticated identity for the operation audit
+                # log; user-task workers inherit it via the copied context.
+                _oplog.set_principal(principal.name)
                 need = required_role(method, endpoint)
                 if not permits(principal.role, need):
                     self._send(403, {

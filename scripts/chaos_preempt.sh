@@ -1,0 +1,139 @@
+#!/usr/bin/env bash
+# Graceful-drain drill: boot the demo service out-of-process, start a large
+# (cold-compile) rebalance solve, SIGTERM mid-solve, and assert the process
+# exits within the shutdown grace budget with a clean executor journal —
+# i.e. the drain cancelled the in-flight solve and it unwound through its
+# next segment boundary instead of running to convergence, and no execution
+# state was left behind.
+#
+# Usage:   ./scripts/chaos_preempt.sh
+# Exit 0 + "PASS" when the drill holds; nonzero with context otherwise.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+GRACE_MS="${GRACE_MS:-60000}"      # solver.shutdown.grace.ms under test
+# Teardown allowance past the grace window: the cancel fires immediately,
+# but the solve cannot probe its budget until the in-flight XLA compile
+# returns, and that compile is the bulk of a cold "large solve".
+SLACK_S="${SLACK_S:-60}"
+WORK="$(mktemp -d)"
+JOURNAL="$WORK/executor-journal.jsonl"
+SVC_OUT="$WORK/svc.out"
+CFG="$WORK/drill.properties"
+
+cleanup() {
+  [[ -n "${SVC_PID:-}" ]] && kill -9 "$SVC_PID" 2>/dev/null || true
+  rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+cat >"$CFG" <<EOF
+metric.sampling.interval.ms=300
+partition.metrics.window.ms=600
+solver.shutdown.grace.ms=$GRACE_MS
+solver.segment.rounds=1
+resilience.journal.path=$JOURNAL
+EOF
+
+# --- boot the demo service on an ephemeral port ---------------------------
+python -m cruise_control_tpu.main --demo --platform cpu \
+  --config "$CFG" --port 0 >"$SVC_OUT" 2>&1 &
+SVC_PID=$!
+for _ in $(seq 300); do
+  grep -q "listening on" "$SVC_OUT" 2>/dev/null && break
+  kill -0 "$SVC_PID" 2>/dev/null || { cat "$SVC_OUT" >&2; exit 1; }
+  sleep 0.2
+done
+BASE="$(sed -n 's#.*listening on \(http[s]*://[^ ]*\).*#\1#p' "$SVC_OUT" | head -1)"
+if [[ -z "$BASE" ]]; then
+  echo "FAIL: service never reported its listen address" >&2
+  cat "$SVC_OUT" >&2
+  exit 1
+fi
+echo "service up at $BASE (pid $SVC_PID)"
+
+# --- wait for a valid monitoring window, then launch the big solve --------
+# One goal keeps the compile bill bounded; the cold XLA compile IS the
+# "large solve" — the SIGTERM lands while it is in flight.
+BASE="$BASE" python - <<'EOF'
+import json, os, time, urllib.request
+
+base = os.environ["BASE"] + "/kafkacruisecontrol"
+
+
+def get(path, method="GET", headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {},
+                                 method=method)
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+deadline = time.monotonic() + 90.0
+while time.monotonic() < deadline:
+    _, body, _ = get("/metrics?json=true")
+    snap = json.loads(body)["sensors"]
+    if snap.get("LoadMonitor.valid-windows", {}).get("value", 0) > 0:
+        break
+    time.sleep(0.5)
+else:
+    raise SystemExit("monitor never produced a valid window")
+
+status, _, headers = get(
+    "/rebalance?dryrun=true&goals=ReplicaDistributionGoal", method="POST")
+assert status == 202, f"expected 202, got {status}"
+print("rebalance submitted, task", headers.get("User-Task-ID"), flush=True)
+
+# The budget registers when the worker thread enters the facade; wait for
+# the analyzer to report the solve in flight before pulling the trigger.
+while time.monotonic() < deadline:
+    _, body, _ = get("/state?substates=analyzer")
+    if '"activeSolves": 0' not in body:
+        break
+    time.sleep(0.05)
+else:
+    raise SystemExit("solve never became active")
+print("solve in flight -- ready for SIGTERM", flush=True)
+EOF
+
+# --- SIGTERM mid-solve; the exit must beat grace + teardown slack ---------
+T0="$(date +%s)"
+kill -TERM "$SVC_PID"
+set +e
+wait "$SVC_PID"
+RC=$?
+set -e
+ELAPSED=$(( $(date +%s) - T0 ))
+SVC_PID=""
+BOUND=$(( GRACE_MS / 1000 + SLACK_S ))
+echo "exit rc=$RC after ${ELAPSED}s (grace $((GRACE_MS / 1000))s + ${SLACK_S}s slack)"
+if [[ "$RC" -ne 0 ]]; then
+  echo "FAIL: service exited rc=$RC, expected clean 0" >&2
+  tail -40 "$SVC_OUT" >&2
+  exit 1
+fi
+if (( ELAPSED > BOUND )); then
+  echo "FAIL: shutdown took ${ELAPSED}s > ${BOUND}s bound" >&2
+  tail -40 "$SVC_OUT" >&2
+  exit 1
+fi
+if ! grep -q "in-flight solve" "$SVC_OUT"; then
+  echo "FAIL: drain never cancelled the in-flight solve" >&2
+  tail -40 "$SVC_OUT" >&2
+  exit 1
+fi
+
+# --- clean journal: a dryrun solve must leave no execution state ----------
+JOURNAL="$JOURNAL" python - <<'EOF'
+import os
+
+from cruise_control_tpu.executor.journal import ExecutionJournal
+
+path = os.environ["JOURNAL"]
+lag = ExecutionJournal(path).lag()
+assert lag == 0, f"journal lag {lag} after drain -- execution state leaked"
+print("journal clean (lag 0)")
+EOF
+
+echo PASS

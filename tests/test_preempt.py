@@ -1,0 +1,348 @@
+"""Deadline-aware preemptible solves: SolveBudget semantics, segmented
+anytime kernels (bitwise parity + cache-key discipline), budget expiry and
+cancellation through optimizer/facade/servlet, user-task timeouts, and the
+operation audit log."""
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import GoalOptimizer
+from cruise_control_tpu.analyzer import solver as solver_mod
+from cruise_control_tpu.analyzer.budget import SolveBudget
+from cruise_control_tpu.common.metrics import registry
+from cruise_control_tpu.servlet.user_tasks import TaskState, UserTaskManager
+from cruise_control_tpu.testing import deterministic as det
+from cruise_control_tpu.testing.verifier import verify_placement
+
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+
+
+@pytest.fixture(scope="module")
+def snapshot():
+    return det.unbalanced2().freeze(pad_replicas_to=64, pad_brokers_to=8)
+
+
+def _tick_clock(step=0.1):
+    """Deterministic monotonic clock: each read advances by ``step``.
+    Returns (clock, cell) so tests can read the final virtual time."""
+    t = {"v": 0.0}
+
+    def clock():
+        t["v"] += step
+        return t["v"]
+    return clock, t
+
+
+def _narrow_solver(**kw):
+    """One accepted move per round: multi-round convergence on the tiny
+    deterministic clusters, so there are segment boundaries to preempt at."""
+    return solver_mod.GoalSolver(max_candidates_per_round=1, **kw)
+
+
+# ----------------------------------------------------------------- budget
+
+
+def test_budget_semantics():
+    b = SolveBudget()
+    assert not b.should_stop() and b.stop_reason() is None
+    assert b.remaining_ms() is None
+    assert not b.segmented                      # cancel-only stays fused
+
+    b = SolveBudget(deadline_ms=100, clock=_tick_clock(0.06)[0])
+    assert b.segmented                          # a deadline implies segments
+    assert b.stop_reason() is None              # t=0.12 < 0.16
+    assert b.stop_reason() == "deadline"        # t=0.18 >= 0.16
+
+    # Cancellation outranks the deadline and the first reason wins.
+    b = SolveBudget(deadline_ms=1, clock=_tick_clock(10.0)[0])
+    b.cancel("slo-preempt")
+    b.cancel("shutdown")
+    assert b.stop_reason() == "slo-preempt"
+    assert b.cancel_reason == "slo-preempt"
+
+    # The reason is pinned on the shared event: a second budget wrapping the
+    # same token (the facade's view of a servlet task token) agrees.
+    ev = threading.Event()
+    first = SolveBudget(cancel_event=ev)
+    first.cancel("user")
+    second = SolveBudget(cancel_event=ev)
+    assert second.cancelled() and second.cancel_reason == "user"
+
+    # segmented=True without a deadline is an explicit opt-in.
+    assert SolveBudget(segmented=True).segmented
+
+
+# -------------------------------------------------- optimizer + solver
+
+
+def test_cancel_before_start_returns_input_placement(snapshot):
+    state, placement, meta = snapshot
+    budget = SolveBudget()
+    budget.cancel("user")
+    c0 = registry().counter("Solver.partial-solves").count
+    x0 = registry().counter("Solver.cancellations").count
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver_mod.GoalSolver())
+    res = opt.optimizations(state, placement, meta, budget=budget)
+    assert res.partial and res.preempt_reason == "user"
+    assert all(i.preempted and i.rounds == 0 for i in res.goal_infos)
+    assert not res.proposals
+    assert np.array_equal(np.asarray(res.final_placement.broker),
+                          np.asarray(placement.broker))
+    assert registry().counter("Solver.partial-solves").count == c0 + 1
+    assert registry().counter("Solver.cancellations").count == x0 + 1
+
+
+def test_segmented_bitwise_equals_fused_and_cache_keys(snapshot):
+    """Acceptance: a budget-less solve builds NO segment executables (its
+    cache keys and results are byte-identical to a pre-segmentation build),
+    and a segmented solve run to convergence is bitwise-equal to the fused
+    single-dispatch loop."""
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver(segment_rounds=1)
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver)
+
+    res_fused = opt.optimizations(state, placement, meta)
+    keys_off = set(solver._round_cache)
+    assert not any(isinstance(k, tuple) and k and k[0] == "segment"
+                   for k in keys_off)
+
+    budget = SolveBudget(segmented=True)        # never cancelled, no deadline
+    res_seg = opt.optimizations(state, placement, meta, budget=budget)
+    assert not res_seg.partial
+
+    new = set(solver._round_cache) - keys_off
+    assert new and all(k[0] == "segment" for k in new)
+    assert keys_off <= set(solver._round_cache)  # off-path entries untouched
+
+    for name in ("broker", "disk", "is_leader"):
+        assert np.array_equal(np.asarray(getattr(res_seg.final_placement, name)),
+                              np.asarray(getattr(res_fused.final_placement, name))), name
+    for a, b in zip(res_seg.goal_infos, res_fused.goal_infos):
+        assert (a.rounds, a.moves_applied, a.violated_brokers_after) == \
+               (b.rounds, b.moves_applied, b.violated_brokers_after)
+
+
+def test_deadline_expires_mid_goal(snapshot):
+    state, placement, meta = snapshot
+    # Deadline at t=0.55 on a 0.1-step clock: the budget survives the first
+    # goal's probes and expires after the second goal's first one-round
+    # segment — a MID-GOAL preemption, deterministic, no wall-clock.
+    budget = SolveBudget(deadline_ms=450, clock=_tick_clock(0.1)[0])
+    opt = GoalOptimizer(goal_names=GOALS,
+                        solver=_narrow_solver(segment_rounds=1))
+    res = opt.optimizations(state, placement, meta, budget=budget)
+    assert res.partial and res.preempt_reason == "deadline"
+    assert any(i.preempted and i.rounds > 0 for i in res.goal_infos)
+    # The partial placement is still safe: executable proposals, no dead
+    # replicas manufactured, no soft-goal regression.
+    fails = verify_placement(state, placement, meta, res.final_placement,
+                             goal_infos=res.goal_infos)
+    assert not fails, [str(f) for f in fails]
+
+
+def test_half_budget_partial_passes_verifier(snapshot):
+    """Acceptance: with the deadline at 50% of the (virtual) time the solve
+    needs to converge, the result is partial=True with strictly fewer rounds
+    than convergence, and the placement passes the verifier."""
+    state, placement, meta = snapshot
+    solver = _narrow_solver(segment_rounds=1)
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver)
+
+    # Calibrate: run to convergence on a tick clock that never expires; the
+    # final virtual time is the budget a full solve needs.
+    clock, cell = _tick_clock(0.1)
+    full = opt.optimizations(state, placement, meta,
+                             budget=SolveBudget(deadline_ms=1e12, clock=clock))
+    assert not full.partial
+    full_rounds = sum(i.rounds for i in full.goal_infos)
+    assert full_rounds >= 2, "scenario converges too fast to preempt"
+
+    clock2, _ = _tick_clock(0.1)
+    res = opt.optimizations(state, placement, meta, budget=SolveBudget(
+        deadline_ms=cell["v"] * 0.5 * 1000.0, clock=clock2))
+    assert res.partial and res.preempt_reason == "deadline"
+    assert sum(i.rounds for i in res.goal_infos) < full_rounds
+    fails = verify_placement(state, placement, meta, res.final_placement,
+                             goal_infos=res.goal_infos)
+    assert not fails, [str(f) for f in fails]
+
+
+# -------------------------------------------------------------- user tasks
+
+
+def test_user_task_timeout_terminal_state():
+    utm = UserTaskManager(num_threads=1, task_timeout_ms=50)
+    token = threading.Event()
+    t = utm.submit("rebalance", "", lambda p: token.wait(5.0),
+                   cancel_token=token)
+    assert t.future.result(timeout=5.0) is True  # woken by the timeout
+    assert t.state is TaskState.TIMED_OUT
+    assert t.cancel_reason == "timeout"
+    assert t.to_dict()["Status"] == "TimedOut"
+    assert t.to_dict()["CancelReason"] == "timeout"
+    utm.shutdown()
+
+
+def test_user_task_completion_beats_timeout():
+    utm = UserTaskManager(num_threads=1, task_timeout_ms=10_000)
+    token = threading.Event()
+    t = utm.submit("rebalance", "", lambda p: 42, cancel_token=token)
+    assert t.future.result(timeout=5.0) == 42
+    assert t.state is TaskState.COMPLETED and not t.timed_out
+    utm.shutdown()
+
+
+def test_user_task_cancel_first_reason_wins():
+    utm = UserTaskManager(num_threads=1)
+    token = threading.Event()
+    t = utm.submit("rebalance", "", lambda p: token.wait(5.0),
+                   cancel_token=token)
+    assert t.cancel("user")
+    t.cancel("timeout")
+    t.future.result(timeout=5.0)
+    assert t.cancel_reason == "user"
+    # A budget wrapping the same event (the facade side) reports the same.
+    assert SolveBudget(cancel_event=token).cancel_reason == "user"
+    # A task with no token cannot be cancelled.
+    t2 = utm.submit("rebalance", "", lambda p: 1)
+    assert not t2.cancel("user")
+    utm.shutdown()
+
+
+# ------------------------------------------------------------------ facade
+
+
+def test_facade_cancel_event_yields_partial_result():
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack()
+    ev = threading.Event()
+    ev.set()                                     # cancelled before start
+    r = cc.rebalance(goals=["ReplicaDistributionGoal"], dryrun=False,
+                     cancel_event=ev)
+    assert r.partial and not r.executed          # cancels never execute
+    d = r.to_dict()
+    assert d["partial"] is True
+    statuses = [g["status"] for g in d["result"]["goals"]]
+    assert "preempted" in statuses
+    assert cc.active_solves() == 0               # budget unregistered
+    assert cc.cancel_active_solves() == 0
+    assert cc.state()["AnalyzerState"]["activeSolves"] == 0
+
+
+def test_facade_deadline_completes_when_generous():
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack()
+    r = cc.rebalance(goals=["ReplicaDistributionGoal"], dryrun=True,
+                     deadline_ms=600_000)
+    assert not r.partial
+    assert "partial" not in r.to_dict()
+
+
+def test_slo_preempt_detector_flips_fixable_for_solve_time():
+    from cruise_control_tpu.detector.anomalies import SloViolationAnomaly
+    from cruise_control_tpu.facade import _SloPreemptDetector
+
+    class Inner:
+        def detect(self):
+            return [SloViolationAnomaly(objective="solve-time", sensor="s"),
+                    SloViolationAnomaly(objective="balancedness", sensor="b")]
+
+    wrapped = _SloPreemptDetector(Inner())
+    a, b = wrapped.detect()
+    assert a.fixable and not b.fixable
+
+
+# ----------------------------------------------------------------- servlet
+
+
+@pytest.fixture(scope="module")
+def app():
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+    from tests.test_facade import build_stack
+
+    cc, _, _ = build_stack(num_brokers=4, partitions=8)
+    application = CruiseControlApp(cc, port=0)
+    application.start()
+    yield application
+    application.stop()
+
+
+def test_deadline_ms_param_validation(app):
+    from tests.test_servlet import _post
+
+    code, body, _ = _post(app, "rebalance", dryrun="true", deadline_ms="abc")
+    assert code == 400 and "deadline_ms" in body["error"]
+    code, body, _ = _post(app, "rebalance", dryrun="true", deadline_ms="-5")
+    assert code == 400
+
+
+def test_cancel_user_task_endpoint(app):
+    from tests.test_servlet import _post
+
+    code, body, _ = _post(app, "cancel_user_task")
+    assert code == 400
+    code, body, _ = _post(app, "cancel_user_task", user_task_id="nope")
+    assert code == 404
+
+    # An in-flight task with a token: cancel returns 200 and wakes it.
+    token = threading.Event()
+    t = app.user_tasks.submit("rebalance", "dryrun=true",
+                              lambda p: token.wait(10.0), cancel_token=token)
+    code, body, _ = _post(app, "cancel_user_task", user_task_id=t.task_id)
+    assert code == 200 and body["UserTaskId"] == t.task_id
+    assert t.future.result(timeout=5.0) is True
+    assert t.cancel_reason == "user"
+
+    # A finished task is no longer cancellable.
+    code, body, _ = _post(app, "cancel_user_task", user_task_id=t.task_id)
+    assert code == 400 and "not active" in body["error"]
+
+
+def test_rebalance_with_deadline_roundtrip(app):
+    from cruise_control_tpu.servlet.server import USER_TASK_HEADER
+    from tests.test_servlet import _post
+
+    status, body, headers = _post(app, "rebalance", dryrun="true",
+                                  goals="ReplicaDistributionGoal",
+                                  deadline_ms="600000")
+    task_id = headers.get(USER_TASK_HEADER)
+    assert task_id
+    deadline = time.time() + 30
+    while status == 202 and time.time() < deadline:
+        time.sleep(0.1)
+        status, body, headers = _post(app, "rebalance",
+                                      headers={USER_TASK_HEADER: task_id},
+                                      dryrun="true",
+                                      goals="ReplicaDistributionGoal",
+                                      deadline_ms="600000")
+    assert status == 200
+    assert "partial" not in body                 # generous budget: converged
+
+
+# ------------------------------------------------------------------- oplog
+
+
+def test_oplog_record_format_and_principal(caplog):
+    from cruise_control_tpu.obsvc import oplog
+
+    with caplog.at_level(logging.INFO, logger="cruise_control_tpu.operations"):
+        oplog.record("start", task_id="tid-1", endpoint="rebalance",
+                     params="dryrun=true", extra_note="two words")
+        tok = oplog.set_principal("alice")
+        try:
+            oplog.record("finish", task_id="tid-1", endpoint="rebalance")
+        finally:
+            oplog._principal.reset(tok)
+    first, second = caplog.messages[-2:]
+    assert "op=start" in first and "principal=anonymous" in first
+    assert 'extra_note="two words"' in first
+    assert "endpoint=rebalance" in first and "task=tid-1" in first
+    assert "op=finish" in second and "principal=alice" in second
+    with pytest.raises(ValueError):
+        oplog.record("explode")

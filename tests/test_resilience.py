@@ -472,7 +472,7 @@ def test_solver_cpu_failover_tags_degraded():
             self.calls = []
 
         def optimizations(self, state, placement, meta, options=None,
-                          model_generation=None):
+                          model_generation=None, budget=None):
             self.calls.append(model_generation)
             if len(self.calls) == 1:
                 raise XlaRuntimeError("DEVICE_LOST: core dumped")
@@ -522,12 +522,13 @@ def test_solver_failover_invalidates_resident_model():
     real = cc.optimizer.optimizations
     calls = {"n": 0}
 
-    def flaky(state, placement, meta, options=None, model_generation=None):
+    def flaky(state, placement, meta, options=None, model_generation=None,
+              budget=None):
         calls["n"] += 1
         if calls["n"] == 1:
             raise XlaRuntimeError("DEVICE_LOST: core dumped")
         return real(state, placement, meta, options=options,
-                    model_generation=model_generation)
+                    model_generation=model_generation, budget=budget)
 
     cc.optimizer.optimizations = flaky
     r = cc.rebalance(dryrun=True)
