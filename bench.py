@@ -40,6 +40,17 @@ one JSON line each (headline LAST):
   the clock.  Each row carries ``partial`` / ``preempted_goals`` next to
   the usual quality fields: what balancedness a fraction of the latency
   buys, and what the segment-boundary overhead costs at 100%.
+- config #8: the convex-relaxation ladder at the healthy north-star shape
+  (2.6K brokers / 1M replicas) — cold lanes, warm lanes, and the full
+  15-goal sequential propose, each solved with ``solver.relaxation``
+  OFF (the greedy baseline) then ON.  Each rung's row carries
+  ``greedy_s`` / ``speedup`` next to the relax-side value, plus the fast
+  path's own attribution: ``relax_ms`` (the fenced ``solve.relax`` span
+  wall), ``repair_rounds`` (the greedy rounds left AFTER rounding — the
+  repair contract's cost), and ``quality_delta`` (relax balancedness
+  minus greedy balancedness; ≥ 0 means the fractional solve lost
+  nothing).  The warm-lane rung is ISSUE 15's acceptance comparison
+  against the r05 4.73 s/lane warm what-if row.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
@@ -138,7 +149,7 @@ def _parse_only(argv):
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
         sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace] "
-                         "[--convergence]  (config numbers 1-7, e.g. "
+                         "[--convergence]  (config numbers 1-8, e.g. "
                          "--only 3 or --only 1,5)\n")
         raise SystemExit(2)
 
@@ -551,6 +562,10 @@ def run(backend: str, only=None) -> None:
     if want(7):
         _deadline_rows(backend)
 
+    # ---- config #8: the convex-relaxation fast path vs pure greedy.
+    if want(8):
+        _relax_rows(backend)
+
     if backend == "cpu":
         _replay_captured_tpu_rows()
 
@@ -603,6 +618,135 @@ def _deadline_rows(backend: str) -> None:
               preempted_goals=sum(1 for g in res.goal_infos if g.preempted),
               **_quality(res), **_compile_fields(fresh))
         del res
+    del state, placement, opt
+
+
+def _relax_rows(backend: str, props=None, lanes=None,
+                num_candidates: int = 512,
+                tag: str = "2600brokers_1m_replicas") -> None:
+    """Config #8 (module docstring): the convex-relaxation fast path vs the
+    pure greedy solver, rung by rung on the healthy north-star snapshot.
+
+    Each rung solves the SAME problem twice — relaxation off (the greedy
+    baseline) then on — and emits ONE row whose ``value`` is the relax-side
+    wall, with ``greedy_s`` / ``speedup`` / ``relax_ms`` /
+    ``repair_rounds`` / ``quality_delta`` alongside.  The lane rungs run
+    the hard stack plus EVERY relax-eligible distribution goal (the family
+    the fast path targets) so both sides optimize an identical stack; each
+    solve gets a fresh broker window so nothing is a literal re-solve.
+    The warm-lane rung is ISSUE 15's acceptance comparison against the r05
+    4.73 s/lane warm row."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import relax as relax_mod
+    from cruise_control_tpu.analyzer.goals.registry import is_relax_eligible
+    from cruise_control_tpu.obsvc.tracer import tracer
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    if props is None:
+        props = rc.ClusterProperties(
+            num_brokers=2600, num_racks=40, num_topics=2000,
+            num_replicas=1_000_000, mean_cpu=0.002, mean_disk=60.0,
+            mean_nw_in=60.0, mean_nw_out=60.0, seed=3142)
+    state, placement, meta = rc.generate(props)
+    if lanes is None:
+        lanes = 64 if backend == "tpu" else 16
+    lane_goals = HARD_GOALS + [g for g in GOALS if is_relax_eligible(g)]
+    opt = GoalOptimizer(goal_names=lane_goals)
+
+    def lane_batch(first: int):
+        ss = [[first + b] for b in range(lanes)]
+        return opt.batch_remove_scenarios(state, placement, meta, ss,
+                                          num_candidates=num_candidates)
+
+    def relax_wall_ms() -> float:
+        # Peek without reset — _emit's own drain closes out the row, so the
+        # row's split_ms still covers the relax-side solve it reports.
+        return round(tracer().rollup().get("solve.relax", {})
+                     .get("total_ms", 0.0), 3)
+
+    prev_on = relax_mod.relaxation_enabled()
+    prev = relax_mod.relaxation_params()
+    try:
+        # ---- rung 1: COLD lanes.  Greedy pays its lane compiles first;
+        # the relax side then pays only its own -X-bucket compile (the
+        # greedy repair executables are shared) — fresh_compiles says what
+        # the timed region actually paid.
+        relax_mod.set_relaxation(False)
+        g_cold_s, g_cold_res, _ = _timed_once(lambda: lane_batch(0))
+        g_cold_q = _batch_quality(g_cold_res)
+        del g_cold_res
+        tracer().rollup(reset=True)     # the row attributes only the relax side
+        relax_mod.set_relaxation(True)
+        r_cold_s, r_cold_res, r_cold_fresh = _timed_once(
+            lambda: lane_batch(lanes))
+        q = _batch_quality(r_cold_res)
+        _emit(f"relax_ladder_cold_lanes_{tag}", r_cold_s, backend,
+              value_per_lane=round(r_cold_s / lanes, 4), lanes=lanes,
+              greedy_s=round(g_cold_s, 4),
+              speedup=round(g_cold_s / max(r_cold_s, 1e-9), 3),
+              relax_ms=relax_wall_ms(),
+              repair_rounds=int(r_cold_res.rounds.sum()),
+              quality_delta=round(
+                  q["balancedness"] - g_cold_q["balancedness"], 3),
+              **q, **_compile_fields(r_cold_fresh))
+        del r_cold_res
+
+        # ---- rung 2: WARM lanes — the acceptance rung.  Every executable
+        # is in-cache on BOTH sides; each side still solves a fresh broker
+        # window, so the pair isolates solve wall, not cache luck.
+        relax_mod.set_relaxation(False)
+        g_warm_s, g_warm_res, _ = _timed_once(lambda: lane_batch(2 * lanes))
+        g_warm_q = _batch_quality(g_warm_res)
+        del g_warm_res
+        tracer().rollup(reset=True)
+        relax_mod.set_relaxation(True)
+        r_warm_s, r_warm_res, r_warm_fresh = _timed_once(
+            lambda: lane_batch(3 * lanes))
+        q = _batch_quality(r_warm_res)
+        _emit(f"relax_ladder_warm_lanes_{tag}", r_warm_s, backend,
+              value_per_lane=round(r_warm_s / lanes, 4),
+              per_lane_vs_budget=round(
+                  NORTH_STAR_BUDGET_S / max(r_warm_s / lanes, 1e-9), 3),
+              lanes=lanes, greedy_s=round(g_warm_s, 4),
+              greedy_s_per_lane=round(g_warm_s / lanes, 4),
+              speedup=round(g_warm_s / max(r_warm_s, 1e-9), 3),
+              relax_ms=relax_wall_ms(),
+              repair_rounds=int(r_warm_res.rounds.sum()),
+              quality_delta=round(
+                  q["balancedness"] - g_warm_q["balancedness"], 3),
+              **q, **_compile_fields(r_warm_fresh))
+        del r_warm_res
+
+        # ---- rung 3: the FULL 15-goal sequential propose on the same
+        # snapshot.  Relax engages on the eligible goals only; the other
+        # goals run today's greedy path, and the repair telemetry comes
+        # straight from the per-goal infos.
+        opt_full = GoalOptimizer(goal_names=GOALS)
+        relax_mod.set_relaxation(False)
+        g_full_s, g_full_res, _ = _timed(
+            lambda: opt_full.optimizations(state, placement, meta))
+        g_full_q = _quality(g_full_res)
+        del g_full_res
+        relax_mod.set_relaxation(True)
+        r_full_s, r_full_res, r_full_fresh = _timed(
+            lambda: opt_full.optimizations(state, placement, meta))
+        q = _quality(r_full_res)
+        infos = [i for i in r_full_res.goal_infos if i.relaxed]
+        _emit(f"relax_ladder_full_goals_{tag}", r_full_s, backend,
+              goals=len(GOALS), relaxed_goals=len(infos),
+              greedy_s=round(g_full_s, 4),
+              speedup=round(g_full_s / max(r_full_s, 1e-9), 3),
+              relax_ms=round(sum(i.relax_ms for i in infos), 3),
+              repair_rounds=sum(i.repair_rounds for i in infos),
+              relax_fallbacks=sum(1 for i in infos if i.relax_fallback),
+              quality_delta=round(
+                  q["balancedness"] - g_full_q["balancedness"], 3),
+              **q, **_compile_fields(r_full_fresh))
+        del r_full_res, opt_full
+    finally:
+        relax_mod.set_relaxation(prev_on, iterations=prev[0],
+                                 candidates=prev[1], waves=prev[2],
+                                 tolerance=prev[3])
     del state, placement, opt
 
 

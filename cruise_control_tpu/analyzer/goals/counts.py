@@ -42,6 +42,10 @@ class ReplicaDistributionGoal(Goal):
     multi_accept_safe = True
     multi_swap_safe = True          # swaps are replica-count-neutral
     multi_leadership_safe = True    # promotions are replica-count-neutral
+    # Count channel: unit mass per replica vs the alive-broker average
+    # (leader subclass inherits with is_leader mass).  TopicReplicaDistribution
+    # is NOT eligible — its band is per (topic, broker), a T×B channel.
+    relax_eligible = True
 
     def _counts(self, gctx, agg):
         return agg.replica_counts
@@ -91,6 +95,17 @@ class ReplicaDistributionGoal(Goal):
         src_ok = (c[src] - 1 >= lower) | ~gctx.state.alive[src]
         offline = currently_offline(gctx, placement, r)
         return dst_ok & (src_ok | offline)
+
+    def relax_weights(self, gctx, placement):
+        return gctx.state.valid.astype(jnp.float32)
+
+    def relax_channel(self, gctx, agg):
+        alive = alive_mask(gctx)
+        c = self._counts(gctx, agg).astype(jnp.float32)
+        n = jnp.maximum(jnp.sum(alive), 1)
+        avg = jnp.sum(jnp.where(alive, c, 0.0)) / n
+        ones = jnp.ones_like(c)
+        return c, avg * ones, ones
 
     def dst_cost(self, gctx, placement, agg, r, dst):
         del r
@@ -163,6 +178,10 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     # Count-band headroom keeps rounds narrower than the default tile, but
     # the under-fill pull needs reach (1024 measurably loses residuals).
     candidate_width_hint = 2048
+
+    def relax_weights(self, gctx, placement):
+        # Only leader replicas carry mass in the leader-count channel.
+        return (gctx.state.valid & placement.is_leader).astype(jnp.float32)
 
     def leadership_cumulative_slack(self, gctx, placement, agg, f, old):
         upper, lower = self._bounds(gctx, agg)

@@ -50,6 +50,9 @@ class ResourceDistributionGoal(Goal):
     # goals' tile width; 1024 candidates lose no rounds (measured) and cut
     # the C×B feasibility cost 4x at north-star scale.
     candidate_width_hint = 1024
+    # One scalar channel per broker (this resource's load vs avg·cap):
+    # exactly the shape the fractional fast path lowers (analyzer/relax.py).
+    relax_eligible = True
     resource: int = Resource.DISK
 
     def __init__(self, resource: int, name: str):
@@ -180,6 +183,17 @@ class ResourceDistributionGoal(Goal):
         own = head_frac[:, resources.index(self.resource)]
         score = jnp.min(head_frac, axis=-1) + 1e-3 * own
         return jnp.where(alive_mask(gctx), score, -jnp.inf)
+
+    def relax_weights(self, gctx, placement):
+        load = jnp.where(placement.is_leader[:, None],
+                         gctx.state.leader_load, gctx.state.follower_load)
+        return load[:, self.resource]
+
+    def relax_channel(self, gctx, agg):
+        res = self.resource
+        avg = avg_alive_util_fraction(gctx, agg, res)
+        cap = gctx.state.capacity[:, res]
+        return agg.broker_load[:, res], avg * cap, jnp.maximum(cap, 1e-9)
 
     def dst_cumulative_slack(self, gctx, placement, agg, cand_load, is_lead_cand):
         upper, _, _ = self._bounds(gctx, agg)

@@ -299,6 +299,9 @@ class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
     uses_replica_moves = False
     has_pull_phase = False
     has_swap_phase = True
+    # Swap-only balancing: the fractional fast path rounds to MOVES, which
+    # this mode forbids — always take the greedy swap path.
+    relax_eligible = False
 
     def __init__(self):
         super().__init__(Resource.DISK, "KafkaAssignerDiskUsageDistributionGoal")

@@ -123,6 +123,26 @@ DEFAULT_INTRA_BROKER_GOALS: List[str] = [
 # The full supported list (config/cruisecontrol.properties:102 `goals`).
 SUPPORTED_GOALS: List[str] = list(_FACTORIES)
 
+# Goals the convex-relaxation fast path (analyzer/relax.py) may lower to a
+# fractional solve: the resource- and count-distribution families, whose
+# objective is one scalar channel per broker.  Everything else — rack,
+# capacity, topic/leadership structure, kafka_assigner, swap-only balancing —
+# falls through to the greedy path bit-for-bit.  Derived from the goal
+# classes' ``relax_eligible`` attribute so a new subclass cannot drift from
+# this list silently.
+RELAX_ELIGIBLE_GOALS: List[str] = [
+    name for name, factory in _FACTORIES.items()
+    if getattr(factory, "relax_eligible", False)
+]
+
+
+def is_relax_eligible(name: str) -> bool:
+    """True when the (bare or fully-qualified) goal name may take the
+    relax→repair path; unknown names are simply ineligible."""
+    factory = _FACTORIES.get(_bare(name))
+    return bool(factory is not None
+                and getattr(factory, "relax_eligible", False))
+
 
 def _bare(name: str) -> str:
     return name.rsplit(".", 1)[-1]

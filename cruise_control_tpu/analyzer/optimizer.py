@@ -27,6 +27,7 @@ from cruise_control_tpu.analyzer.goals.registry import (
 )
 from cruise_control_tpu.analyzer.options import OptimizationOptions
 from cruise_control_tpu.analyzer.proposals import diff_proposals
+from cruise_control_tpu.analyzer import relax as _relax
 from cruise_control_tpu.analyzer.solver import (
     GoalOptimizationInfo,
     GoalSolver,
@@ -326,6 +327,12 @@ class GoalOptimizer:
                                else tuple(self.goal_names))
             cache_key = (model_generation, effective_names, options,
                          self.polish_passes)
+            if _relax.relaxation_enabled():
+                # The relax knobs shape the result, so they join the key —
+                # but ONLY when the fast path is on, keeping the off-path
+                # cache key (and thus hit/miss behavior) byte-identical.
+                cache_key = cache_key + (
+                    ("relax",) + _relax.relaxation_params(),)
             with self._cache_lock:
                 hit = self._cached.get(cache_key)
             if hit is not None:
@@ -389,15 +396,37 @@ class GoalOptimizer:
             # the solve, compile-vs-execute split from compilesvc telemetry
             # deltas (execute_ms materializes at render time as
             # wall_ms - compile_ms).
+            # Convex-relaxation fast path: eligible distribution goals solve
+            # fractionally + round, with the greedy solve demoted to a short
+            # warm-started repair.  Deadline (segmented) solves stay on the
+            # greedy path — its preemption seams (segment boundaries, anytime
+            # results) have no relax equivalent.  Cancel-only budgets take
+            # the fast path: their fused greedy solve is byte-identical to a
+            # budget-less one and cancellation is honored at goal boundaries
+            # either way (every servlet operation carries a cancel token, so
+            # gating on budget-is-None would leave the fast path dead in the
+            # service).
+            use_relax = (_relax.relaxation_enabled()
+                         and (budget is None or not budget.segmented)
+                         and getattr(goal, "relax_eligible", False))
             with tr.span(f"goal.{goal.name}", bucket=bucket) as gsp:
                 c0, s0 = tel.compile_count(), tel.compile_seconds_total()
-                placement, agg, info = self.solver.optimize_goal(
-                    goal, priors, gctx, placement, agg, budget=budget)
+                if use_relax:
+                    placement, agg, info = _relax.optimize_goal_relaxed(
+                        self.solver, goal, priors, gctx, placement, agg)
+                else:
+                    placement, agg, info = self.solver.optimize_goal(
+                        goal, priors, gctx, placement, agg, budget=budget)
                 gsp.set("rounds", info.rounds)
                 gsp.set("moves", info.moves_applied)
                 gsp.set("fresh_compiles", tel.compile_count() - c0)
                 gsp.set("compile_ms", round(
                     (tel.compile_seconds_total() - s0) * 1000.0, 3))
+                if info.relaxed:
+                    gsp.set("relaxed", True)
+                    gsp.set("relax_ms", round(info.relax_ms, 3))
+                    if info.relax_fallback:
+                        gsp.set("relax_fallback", True)
                 if info.preempted:
                     gsp.set("preempted", info.preempt_reason)
             infos.append(info)
@@ -482,7 +511,11 @@ class GoalOptimizer:
         _convergence().record_solve(
             [{"goal": inf.goal_name, "curve": inf.round_curve,
               "metric_before": inf.metric_before, "rounds": inf.rounds,
-              "moves": inf.moves_applied} for inf in infos],
+              "moves": inf.moves_applied,
+              **({"relax_ms": round(inf.relax_ms, 3),
+                  "repair_rounds": inf.repair_rounds,
+                  "relax_fallback": inf.relax_fallback}
+                 if inf.relaxed else {})} for inf in infos],
             kind="propose" if not partial else "propose-partial",
             attrs={"generation": model_generation,
                    **({"preempted": preempt_reason} if partial else {})})
@@ -759,6 +792,37 @@ class GoalOptimizer:
             # runs so every lane has a solved placement to return.
             if (budget is not None and priors and budget.should_stop()):
                 break
+            # Convex-relaxation fast path, vmapped across the lane block:
+            # each lane's placement is replaced by its fractional-solve +
+            # rounded warm start, and the existing vmapped greedy solve below
+            # runs unchanged as the per-lane repair pass (few rounds to the
+            # fixed point instead of a full ladder).  One extra dispatch per
+            # eligible goal, shared by every lane in the block.  Same budget
+            # gate as the sequential path: cancel-only budgets take the fast
+            # path (the batch solve is never segmented), deadline budgets
+            # stay greedy.
+            if (_relax.relaxation_enabled()
+                    and (budget is None or not budget.segmented)
+                    and getattr(goal, "relax_eligible", False)):
+                iters, k_cfg, waves, _tol = _relax.relaxation_params()
+                k = min(k_cfg, num_candidates, state.num_replicas_padded)
+                rfn = _relax._relax_batch_fn(
+                    self.solver, goal, tuple(priors),
+                    state.num_replicas_padded, k, waves)
+                tr = _obsvc_tracer()
+                if tr.enabled:
+                    # Fence inside the span so relax_ms is device wall, not
+                    # dispatch wall (same discipline as the solve spans).
+                    with tr.span("solve.relax", goal=goal.name, lanes=s_n,
+                                 candidates=k):
+                        placement_s = rfn(gctx, alive_j, excl_move_j,
+                                          excl_lead_j, placement_s,
+                                          jnp.int32(iters))
+                        jax.block_until_ready(placement_s)
+                else:
+                    placement_s = rfn(gctx, alive_j, excl_move_j,
+                                      excl_lead_j, placement_s,
+                                      jnp.int32(iters))
             batch = self.solver._batch_solve_fn(
                 goal, tuple(priors), state.num_replicas_padded, num_candidates)
             (placement_s, rounds_d, moves_d, violated_d, stranded_d,

@@ -1,0 +1,379 @@
+"""Convex-relaxation fast path: fractional solve + wave rounding, greedy
+demoted to integer repair.
+
+The greedy kernel converges a distribution goal by iterated batched rounds —
+tens of dispatches of a C×B feasibility tile at north-star scale.  For the
+resource- and count-distribution families the objective is analytically
+simple: each broker carries one scalar channel (a resource's load, a replica
+count) and the goal wants every alive broker's channel near the cluster
+average.  That lowers to a CONTINUOUS assignment problem (the CvxCluster /
+GOMA observation in PAPERS.md — granular allocation relaxed to a convex
+program is orders of magnitude cheaper than discrete search):
+
+1. **Fractional solve** — pick the K highest-priority movable replicas (the
+   same candidate score the greedy phase uses, so over-band brokers shed
+   first), give each a row of fractional mass ``X[k, b] ≥ 0, Σ_b X[k,b] = 1``
+   over its structurally-feasible destinations (``base_replica_move_ok``
+   plus its own broker), and minimize the capacity-normalized squared
+   residual ``Σ_b ((fixed_b + Σ_k w_k X[k,b] − target_b) / scale_b)²`` by
+   entropic mirror descent (exponentiated gradient: logits accumulate the
+   normalized rank-1 gradient, softmax projects back onto the simplex — no
+   per-iteration sort).  One fixed-iteration ``lax.while_loop`` with the
+   iteration bound a traced scalar, so one executable serves every
+   configured depth.
+
+2. **Wave rounding** — transport-style conservative rounding: each wave
+   sends every unsettled candidate to its argmax-mass destination, but only
+   where the move passes the SAME acceptance stack the greedy kernel
+   enforces (structural + every prior goal's acceptance + this goal's
+   self-check, against current aggregates) and wins its partition /
+   destination / source / host group (one move per group per wave, so no
+   cumulative-headroom bookkeeping is needed for priors that don't compose).
+   Vetoed destinations are masked and the next wave tries the runner-up.
+   Rounding therefore can never worsen a previously-optimized goal.
+
+3. **Greedy repair** — the rounded placement goes to the EXISTING fused
+   greedy solve as a warm start.  The placement is a traced input, so repair
+   reuses the normal per-goal executable with zero new compiles; the loop's
+   own convergence/stall cutoffs bound the pass.
+
+Wired behind ``solver.relaxation.enabled`` + per-goal ``relax_eligible``
+(goals/registry.py): ineligible goals — and every goal when the flag is off —
+take the current path bit-for-bit (no relax executables are ever built, no
+cache keys change; the PR 9/10 parity discipline).  Compilesvc buckets for
+the relax executables get an ``-X`` suffix via :meth:`GoalSolver.relax_cached`
+so their cache keys stay disjoint from the greedy family's.
+
+Sensors: ``Solver.relax.attempts`` / ``Solver.relax.fallbacks`` counters,
+``Solver.relax.repair-rounds`` / ``Solver.relax.quality-delta`` /
+``Solver.relax.fractional-moves`` gauges.  Spans: ``solve.relax`` around the
+fractional+rounding dispatch (the repair keeps its normal ``goal.*`` span
+accounting).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    GoalContext,
+    apply_replica_moves_batch,
+    base_replica_move_ok,
+    compute_aggregates,
+)
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.solver import (
+    _SCORE_FLOOR,
+    _chain_accept_replica,
+    _group_winners,
+    _pick_dst_disk,
+    GoalOptimizationInfo,
+    GoalSolver,
+)
+from cruise_control_tpu.model.state import Placement
+from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
+
+ATTEMPTS_SENSOR = "Solver.relax.attempts"
+FALLBACKS_SENSOR = "Solver.relax.fallbacks"
+REPAIR_ROUNDS_SENSOR = "Solver.relax.repair-rounds"
+QUALITY_DELTA_SENSOR = "Solver.relax.quality-delta"
+FRACTIONAL_MOVES_SENSOR = "Solver.relax.fractional-moves"
+
+# Mirror-descent step in logit space per (normalized) iteration.  The
+# gradient is normalized to unit max, so total logit travel is bounded by
+# eta * iterations — enough to fully commit a row at the default depth while
+# keeping early iterations exploratory.
+_MD_STEP = 1.0
+# Initial preference for staying home: softmax(±bias) keeps the start near
+# the current placement instead of uniform, so barely-over brokers shed only
+# what the objective actually asks for.
+_HOME_BIAS = 1.0
+_NEG_INF = -1e30
+
+# ---------------------------------------------------------------------------
+# Process-wide config (wired by main.build_app from solver.relaxation.*).
+# Defaults match config/cruise_control_config.py; enabled stays False so a
+# bare import is always byte-identical to the pre-relaxation solver.
+
+_RELAXATION = {
+    "enabled": False,
+    "iterations": 48,
+    "candidates": 4096,
+    "waves": 4,
+    "tolerance": 0.05,
+}
+
+
+def set_relaxation(enabled: bool, iterations: Optional[int] = None,
+                   candidates: Optional[int] = None,
+                   waves: Optional[int] = None,
+                   tolerance: Optional[float] = None) -> None:
+    """Process-wide relaxation switch + knobs (solver.relaxation.*)."""
+    _RELAXATION["enabled"] = bool(enabled)
+    if iterations is not None:
+        _RELAXATION["iterations"] = max(1, int(iterations))
+    if candidates is not None:
+        _RELAXATION["candidates"] = max(1, int(candidates))
+    if waves is not None:
+        _RELAXATION["waves"] = max(1, int(waves))
+    if tolerance is not None:
+        _RELAXATION["tolerance"] = max(0.0, float(tolerance))
+
+
+def relaxation_enabled() -> bool:
+    return bool(_RELAXATION["enabled"])
+
+
+def relaxation_params() -> Tuple[int, int, int, float]:
+    """(iterations, candidates, waves, tolerance) — the proposal-cache key
+    fragment when the fast path is on."""
+    return (int(_RELAXATION["iterations"]), int(_RELAXATION["candidates"]),
+            int(_RELAXATION["waves"]), float(_RELAXATION["tolerance"]))
+
+
+def relaxation_tolerance() -> float:
+    return float(_RELAXATION["tolerance"])
+
+
+def relax_sensors() -> None:
+    """Materialize the Solver.relax.* family at boot so /metrics and the
+    docs/SENSORS.md drift guard see it before the first relaxed solve."""
+    from cruise_control_tpu.common.metrics import registry
+    reg = registry()
+    reg.counter(ATTEMPTS_SENSOR)
+    reg.counter(FALLBACKS_SENSOR)
+    reg.settable_gauge(REPAIR_ROUNDS_SENSOR)
+    reg.settable_gauge(QUALITY_DELTA_SENSOR)
+    reg.settable_gauge(FRACTIONAL_MOVES_SENSOR)
+
+
+# ---------------------------------------------------------------------------
+# The jitted fractional solve + wave rounding.
+
+
+def _relax_body(goal: Goal, priors: Tuple[Goal, ...], k: int, waves: int):
+    """(gctx, placement, agg0, iters) ->
+    (placement, agg, frac_moves, violated0, metric0).
+
+    ``iters`` is a traced int32 so the mirror-descent depth is a config
+    knob, not a compile trigger.  ``agg`` in the output is a FRESH full
+    recompute — the repair pass starts from exact aggregates."""
+    accept = _chain_accept_replica(priors)
+
+    def relaxed(gctx: GoalContext, placement: Placement, agg0: Aggregates,
+                iters):
+        state = gctx.state
+        b = state.num_brokers_padded
+        # Pre-relax residuals: free here, and exactly what the repair's
+        # GoalOptimizationInfo must report as its "before" numbers.
+        violated0 = jnp.sum(goal.violated_brokers(gctx, placement, agg0)
+                            .astype(jnp.int32))
+        metric0 = goal.stats_metric(gctx, placement, agg0)
+
+        # --- candidate tile (same priority order as the greedy move phase)
+        score = goal.candidate_score(gctx, placement, agg0)
+        top_score, cand = jax.lax.top_k(score, k)
+        is_cand = top_score > _SCORE_FLOOR
+        src0 = placement.broker[cand]
+        w = jnp.where(is_cand, goal.relax_weights(gctx, placement)[cand], 0.0)
+
+        # --- the channel: fixed load excludes the candidates' movable mass
+        load, target, scale = goal.relax_channel(gctx, agg0)
+        fixed = load - jax.ops.segment_sum(w, src0, num_segments=b)
+        inv_s2 = 1.0 / jnp.maximum(scale, 1e-9) ** 2
+
+        # --- feasible-destination mask: structural legitMove ∪ stay-home.
+        b_ids = jnp.arange(b, dtype=jnp.int32)
+        feas = base_replica_move_ok(gctx, placement, cand[:, None],
+                                    b_ids[None, :]) & is_cand[:, None]
+        home = b_ids[None, :] == src0[:, None]
+        mask = feas | home                        # home row keeps softmax finite
+        z0 = jnp.where(mask, jnp.where(home, _HOME_BIAS, 0.0), _NEG_INF)
+        w_max = jnp.maximum(jnp.max(w), 1e-9)
+
+        # --- entropic mirror descent on the row simplexes
+        def md_cond(carry):
+            return carry[0] < iters
+
+        def md_body(carry):
+            i, z = carry
+            x = jax.nn.softmax(z, axis=-1)
+            chan = fixed + jnp.matmul(w, x)                       # f32[B]
+            g = 2.0 * (chan - target) * inv_s2
+            g = g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
+            z = z - _MD_STEP * (w[:, None] / w_max) * g[None, :]
+            return i + 1, jnp.where(mask, z, _NEG_INF)
+
+        _, z = jax.lax.while_loop(md_cond, md_body, (jnp.int32(0), z0))
+
+        # --- wave rounding against the live acceptance stack
+        agg = agg0
+        settled = ~is_cand
+        moves = jnp.int32(0)
+        kidx = jnp.arange(k, dtype=jnp.int32)
+        for _ in range(waves):
+            dst = jnp.argmax(z, axis=-1).astype(jnp.int32)
+            src = placement.broker[cand]
+            want = ~settled & (dst != src)
+            ok = (want
+                  & accept(gctx, placement, agg, cand, dst)
+                  & goal.self_ok(gctx, placement, agg, cand, dst))
+            order = jnp.where(ok, kidx, k)
+            keep = (ok
+                    & _group_winners(order, state.partition[cand],
+                                     gctx.num_partitions)
+                    & _group_winners(order, dst, b)
+                    & _group_winners(order, src, b)
+                    & _group_winners(order, state.host[dst], gctx.num_hosts))
+            dd = _pick_dst_disk(gctx, agg, dst)
+            dst_eff = jnp.where(keep, dst, src)
+            dd_eff = jnp.where(keep, dd, placement.disk[cand])
+            placement, agg = apply_replica_moves_batch(
+                gctx, placement, agg, cand, dst_eff, dd_eff)
+            moves = moves + jnp.sum(keep.astype(jnp.int32))
+            # Settled: moved, or the mass already prefers home.  Vetoed
+            # destinations are masked so the next wave tries the runner-up
+            # (home stays finite, so rows can always resolve to a no-op).
+            settled = settled | keep | (dst == src)
+            veto = want & ~keep
+            z = jnp.where(veto[:, None] & (b_ids[None, :] == dst[:, None]),
+                          _NEG_INF, z)
+
+        # Fresh aggregates clear the waves' incremental scatter drift before
+        # the repair pass reads its "before" residuals from them.
+        return (placement, compute_aggregates(gctx, placement), moves,
+                violated0, metric0)
+
+    return relaxed
+
+
+def _relax_fn(solver: GoalSolver, goal: Goal, priors: Tuple[Goal, ...],
+              num_replicas_padded: int, k: int, waves: int):
+    """The sequential-path relax executable, cached under the ``-X`` bucket
+    family (disjoint from every greedy cache key by construction)."""
+    key = ("frac", goal.key(), tuple(g.key() for g in priors), k, waves)
+    return solver.relax_cached(
+        key, f"R{num_replicas_padded}-C{k}",
+        lambda: jax.jit(_relax_body(goal, priors, k, waves)))
+
+
+def _relax_batch_fn(solver: GoalSolver, goal: Goal, priors: Tuple[Goal, ...],
+                    num_replicas_padded: int, k: int, waves: int):
+    """Vmapped relax over what-if lanes: every lane rebuilds its own
+    liveness/exclusion context (mirroring ``_batch_solve_fn``) and returns
+    only the rounded placement — the existing vmapped greedy solve then runs
+    as the repair pass with no new executable."""
+    key = ("frac-batch", goal.key(), tuple(g.key() for g in priors), k, waves)
+
+    def build():
+        body = _relax_body(goal, priors, k, waves)
+
+        @jax.jit
+        def batch(gctx: GoalContext, alive_s, excl_move_s, excl_lead_s,
+                  placement_s, iters):
+            def one(alive, excl_move, excl_lead, placement):
+                state = gctx.state.replace(alive=alive)
+                ok = alive & state.broker_valid
+                host_cap = jax.ops.segment_sum(
+                    jnp.where(ok[:, None], state.capacity, 0.0),
+                    state.host, num_segments=gctx.num_hosts)
+                g2 = gctx.replace(
+                    state=state, host_capacity=host_cap,
+                    excluded_for_replica_move=excl_move,
+                    excluded_for_leadership=excl_lead)
+                out = body(g2, placement,
+                           compute_aggregates(g2, placement), iters)
+                return out[0]
+            return jax.vmap(one, in_axes=(0, 0, 0, 0))(
+                alive_s, excl_move_s, excl_lead_s, placement_s)
+        return batch
+
+    return solver.relax_cached(
+        key, f"R{num_replicas_padded}-C{k}", build,
+        label_fn=lambda gctx, alive_s, *a, **kw:
+            f"R{num_replicas_padded}-C{k}-X-L{alive_s.shape[0]}")
+
+
+# ---------------------------------------------------------------------------
+# Sequential-path entry point.
+
+
+def optimize_goal_relaxed(solver: GoalSolver, goal: Goal,
+                          priors: Sequence[Goal], gctx: GoalContext,
+                          placement: Placement,
+                          agg: Optional[Aggregates] = None,
+                          ) -> Tuple[Placement, Aggregates,
+                                     GoalOptimizationInfo]:
+    """Relax → round → greedy repair for one eligible goal; drop-in for
+    :meth:`GoalSolver.optimize_goal` on the unbudgeted sequential path.
+
+    The returned info reports the WHOLE pass against the pre-relax placement
+    (metric/violated "before" come from the original state, moves include the
+    rounding waves' moves, ``rounds`` is the repair's round count) so the
+    optimizer's hard-goal and no-worsen verdicts keep their meaning.  If the
+    relaxed result regresses the goal vs the original placement, the pass
+    falls back to pure greedy from the ORIGINAL placement
+    (``Solver.relax.fallbacks``) — the fast path may only ever win.
+    """
+    from cruise_control_tpu.common.metrics import registry
+
+    if agg is None:
+        agg = solver.aggregates(gctx, placement)
+    iters, k_cfg, waves, _tol = relaxation_params()
+    r_pad = gctx.state.num_replicas_padded
+    k = min(k_cfg, r_pad)
+    fn = _relax_fn(solver, goal, tuple(priors), r_pad, k, waves)
+    tr = _obsvc_tracer()
+    t0 = time.monotonic()
+    if tr.enabled:
+        with tr.span("solve.relax", goal=goal.name, candidates=k,
+                     waves=waves, iterations=iters) as sp:
+            with jax.profiler.TraceAnnotation(f"cc.relax.{goal.name}"):
+                out = jax.block_until_ready(
+                    fn(gctx, placement, agg, jnp.int32(iters)))
+            sp.set("frac_moves", int(out[2]))
+            sp.add_ms("device_ms",
+                      round((time.monotonic() - t0) * 1000.0, 3))
+    else:
+        out = fn(gctx, placement, agg, jnp.int32(iters))
+    rounded_pl, rounded_agg, frac_moves, violated0, metric0 = out
+    relax_ms = (time.monotonic() - t0) * 1000.0
+    registry().counter(ATTEMPTS_SENSOR).inc()
+
+    # Greedy repair from the rounded placement: the placement is a traced
+    # input of the normal solve executable, so this compiles nothing new.
+    pl2, agg2, info = solver.optimize_goal(goal, priors, gctx, rounded_pl,
+                                           rounded_agg)
+    regressed = (
+        info.violated_brokers_after > int(violated0)
+        or info.metric_after > float(metric0) * (1 + 1e-5) + 1e-9)
+    if regressed:
+        # The relaxation hurt this goal (possible when rounding's per-wave
+        # conservatism strands mass) — discard it entirely.
+        registry().counter(FALLBACKS_SENSOR).inc()
+        pl2, agg2, info = solver.optimize_goal(goal, priors, gctx, placement,
+                                               agg)
+        info.relaxed = True
+        info.relax_fallback = True
+        info.relax_ms = relax_ms
+        return pl2, agg2, info
+
+    # Re-anchor the info at the pre-relax state so the optimizer's verdicts
+    # (and the convergence recorder) judge the whole relax+repair pass.
+    info.relaxed = True
+    info.relax_ms = relax_ms
+    info.repair_rounds = info.rounds
+    info.moves_applied += int(frac_moves)
+    info.violated_brokers_before = int(violated0)
+    info.metric_before = float(metric0)
+    registry().settable_gauge(REPAIR_ROUNDS_SENSOR).set(info.repair_rounds)
+    registry().settable_gauge(FRACTIONAL_MOVES_SENSOR).set(int(frac_moves))
+    denom = max(abs(float(metric0)), 1e-9)
+    registry().settable_gauge(QUALITY_DELTA_SENSOR).set(
+        (float(metric0) - info.metric_after) / denom)
+    return pl2, agg2, info

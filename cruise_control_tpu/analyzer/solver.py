@@ -164,6 +164,16 @@ class GoalOptimizationInfo:
     preempted: bool = False
     # Why the solve stopped early ("deadline", "cancelled", operator reason).
     preempt_reason: Optional[str] = None
+    # Convex-relaxation fast path (analyzer/relax.py).  When relaxed=True the
+    # info covers the WHOLE relax+round+repair pass: metric/violated "before"
+    # are re-anchored at the pre-relax placement, moves_applied includes the
+    # rounding waves' moves, and rounds is the greedy repair's round count
+    # (mirrored in repair_rounds for telemetry).  relax_fallback marks a pass
+    # whose relaxed result regressed and was discarded for pure greedy.
+    relaxed: bool = False
+    relax_ms: float = 0.0
+    repair_rounds: int = 0
+    relax_fallback: bool = False
 
     @property
     def succeeded(self) -> bool:
@@ -1111,6 +1121,16 @@ class GoalSolver:
         fn = _CompileTracked(build(), label_fn or (lambda *a, **k: bucket))
         self._round_cache[key] = fn
         return fn
+
+    def relax_cached(self, key, bucket: str, build, label_fn=None):
+        """Cache slot for the convex-relaxation executables (analyzer/relax.py).
+
+        Namespaced under ``("relax",) + key`` and bucketed with an ``-X``
+        suffix so the fast path's cache keys and compilesvc buckets stay
+        disjoint from the greedy family — with relaxation off, no key in this
+        namespace is ever created (the bitwise fall-through guarantee)."""
+        return self._cached_executable(("relax",) + tuple(key),
+                                       bucket + "-X", build, label_fn)
 
     def _round_fn(self, goal: Goal, priors: Tuple[Goal, ...], num_replicas_padded: int):
         """One jitted solver round (kept for the driver's single-chip

@@ -450,6 +450,33 @@ def _solver_def() -> ConfigDef:
                  "(the anomaly becomes fixable and the fix cancels every "
                  "active solve budget with reason slo-preempt).  Requires "
                  "slo.enabled and self-healing for SLO_VIOLATION")
+    d.define("solver.relaxation.enabled", ConfigType.BOOLEAN, False,
+             doc="convex-relaxation fast path for relax-eligible "
+                 "distribution goals (analyzer/relax.py): fractional "
+                 "mirror-descent solve + transport-style rounding, with the "
+                 "greedy kernel demoted to a warm-started integer repair "
+                 "pass.  Ineligible goals — and everything when off — take "
+                 "the greedy path bit-for-bit (identical executables, cache "
+                 "keys, and results).  Budgeted/deadline solves always stay "
+                 "on the greedy path")
+    d.define("solver.relaxation.iterations", ConfigType.INT, 48,
+             range_validator(1),
+             doc="mirror-descent iterations for the fractional solve; a "
+                 "traced loop bound, so changing it never recompiles")
+    d.define("solver.relaxation.candidates", ConfigType.INT, 4096,
+             range_validator(1),
+             doc="top-K movable replicas given fractional mass per goal "
+                 "(clamped to the replica pad; compile-time tile width, "
+                 "same role as the greedy candidate width)")
+    d.define("solver.relaxation.waves", ConfigType.INT, 4, range_validator(1),
+             doc="rounding waves: each wave commits at most one accepted "
+                 "move per partition/src/dst/host group, vetoed "
+                 "destinations retry their runner-up next wave")
+    d.define("solver.relaxation.tolerance", ConfigType.DOUBLE, 0.05,
+             range_validator(0),
+             doc="relative soft-goal balancedness slack the relax+repair "
+                 "result may trail pure greedy by before the fuzz "
+                 "relaxation_sound invariant flags it")
     return d
 
 

@@ -312,6 +312,13 @@ class CruiseControl:
                 if not self.load_monitor.meet_completeness_requirements(
                         self.default_completeness
                         or ModelCompletenessRequirements()):
+                    # Too early for the proposal solve, but not for the model:
+                    # fold the monitor's pending journal into the resident
+                    # entry now, on the daemon's clock, so the first request
+                    # after the window completes starts from current device
+                    # tensors instead of paying the accumulated delta (or an
+                    # overflow-forced full freeze).
+                    self._pre_apply_resident_deltas(generation)
                     continue
                 # Root span: the daemon thread has no request context, so
                 # each precompute tick is its own trace in the ring.
@@ -320,6 +327,24 @@ class CruiseControl:
                 self._precomputed_generation = generation
             except Exception as e:          # noqa: BLE001 — keep the daemon up
                 LOG.warning("proposal precompute failed: %s", e)
+
+    def _pre_apply_resident_deltas(self, generation) -> None:
+        """Resident-model follow-on (docs/RESIDENT.md): a precompute tick
+        that cannot run the full solve yet still advances the device model.
+        The snapshot path applies whatever delta the journal holds (or
+        no-ops when nothing is pending); the pin is released immediately —
+        nothing solves here, the point is moving the scatter off the first
+        request's critical path."""
+        if not self.resident.enabled:
+            return
+        try:
+            with _obsvc_tracer().span("precompute.delta_preapply",
+                                      generation=generation):
+                self._resident_snapshot()
+        except Exception as e:   # noqa: BLE001 — monitor may still be booting
+            LOG.debug("resident delta pre-apply skipped: %s", e)
+        else:
+            self.resident.release()
 
     # ------------------------------------------------------- compile warmup
 
@@ -445,6 +470,46 @@ class CruiseControl:
                 if pinned:
                     self.resident.release()
 
+        def warm_relax():
+            # Convex-relaxation fast path: compile the fractional+rounding
+            # executable per eligible goal at the bucket shape, with the same
+            # priority-ordered priors chain the optimizer will use.  No-op
+            # (and no relax cache keys) when the fast path is off.
+            from cruise_control_tpu.analyzer import relax as _relax
+            if not _relax.relaxation_enabled():
+                return
+            import jax.numpy as jnp
+
+            from cruise_control_tpu.analyzer.context import build_context
+            from cruise_control_tpu.analyzer.goals.registry import (
+                get_goals_by_priority,
+            )
+            wait_model_ready()
+            (state, placement, meta), pinned = _warm_snapshot()
+            try:
+                solver = self.optimizer.solver
+                gctx = build_context(state, placement, meta,
+                                     self.optimizer.constraint,
+                                     OptimizationOptions())
+                gctx, placement = solver.shard_inputs(gctx, placement)
+                agg = solver.aggregates(gctx, placement)
+                iters, k_cfg, waves, _tol = _relax.relaxation_params()
+                k = min(k_cfg, state.num_replicas_padded)
+                priors = []
+                for goal in get_goals_by_priority(self.default_goals):
+                    if daemon.should_abort():
+                        return
+                    if getattr(goal, "relax_eligible", False):
+                        fn = _relax._relax_fn(solver, goal, tuple(priors),
+                                              state.num_replicas_padded, k,
+                                              waves)
+                        out = fn(gctx, placement, agg, jnp.int32(iters))
+                        out[0].broker.block_until_ready()
+                    priors.append(goal)
+            finally:
+                if pinned:
+                    self.resident.release()
+
         daemon.add_task(("proposals", tuple(self.default_goals)),
                         warm_proposals)
         # The lane ladder is a LIST: each width warms its own vmapped
@@ -454,6 +519,7 @@ class CruiseControl:
             daemon.add_task(("whatif", tuple(self.default_goals), w),
                             lambda w=w: _warm_whatif(w))
         daemon.add_task(("warm_delta", tuple(self.default_goals)), warm_delta)
+        daemon.add_task(("relax", tuple(self.default_goals)), warm_relax)
         return daemon
 
     def _offline_logdirs(self):

@@ -438,6 +438,43 @@ def partial_solve_safe(m: Materialized) -> List[str]:
     return out
 
 
+def relaxation_sound(m: Materialized) -> List[str]:
+    """Convex-relaxation fast path soundness: re-solve the scenario with
+    ``solver.relaxation.enabled`` on.  The relax+round+repair result must
+    pass the same safety net as any solve (hard goals never worsen, load
+    conservation, executable proposals) and each goal's final soft metric
+    must land within ``solver.relaxation.tolerance`` of pure greedy's —
+    the fast path is allowed to trade exact tie-breaking for speed, never
+    balance quality beyond the configured slack."""
+    from cruise_control_tpu.analyzer import relax as relax_mod
+
+    prev = relax_mod.relaxation_enabled()
+    relax_mod.set_relaxation(True)
+    try:
+        res = GoalOptimizer(goal_names=list(m.scenario.goal_names)
+                            ).optimizations(m.state, m.placement, m.meta)
+    finally:
+        relax_mod.set_relaxation(prev)
+    out: List[str] = []
+    shadow = Materialized(m.scenario, state=m.state, placement=m.placement,
+                          meta=m.meta, _base=res)
+    for check in (hard_goals_never_worsen, load_conservation,
+                  proposals_executable):
+        out.extend(f"[relax] {d}" for d in check(shadow))
+    tol = relax_mod.relaxation_tolerance()
+    base_by_name = {i.goal_name: i for i in m.base.goal_infos}
+    for info in res.goal_infos:
+        b = base_by_name.get(info.goal_name)
+        if b is None:
+            continue
+        slack = tol * max(abs(b.metric_before), abs(b.metric_after)) + 1e-6
+        if info.metric_after > b.metric_after + slack:
+            out.append(f"{info.goal_name}: relaxed metric "
+                       f"{info.metric_after:.6g} trails greedy "
+                       f"{b.metric_after:.6g} beyond tolerance {tol}")
+    return out
+
+
 INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "hard_goals_never_worsen": hard_goals_never_worsen,
     "soft_goals_no_regression": soft_goals_no_regression,
@@ -446,6 +483,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "resident_delta_equivalence": resident_delta_equivalence,
     "convergence_curve_coherent": convergence_curve_coherent,
     "partial_solve_safe": partial_solve_safe,
+    "relaxation_sound": relaxation_sound,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

@@ -284,6 +284,16 @@ def build_app(config: CruiseControlConfig,
     # per-request custom-goal solvers all pick it up.
     from cruise_control_tpu.analyzer.solver import set_default_segment_rounds
     set_default_segment_rounds(int(config["solver.segment.rounds"]))
+    # Convex-relaxation fast path (analyzer/relax.py): a process-wide switch
+    # like the segment width, set before any optimizer routes a goal, and its
+    # Solver.relax.* sensors materialized for the drift guard.
+    from cruise_control_tpu.analyzer.relax import relax_sensors, set_relaxation
+    set_relaxation(bool(config["solver.relaxation.enabled"]),
+                   iterations=int(config["solver.relaxation.iterations"]),
+                   candidates=int(config["solver.relaxation.candidates"]),
+                   waves=int(config["solver.relaxation.waves"]),
+                   tolerance=float(config["solver.relaxation.tolerance"]))
+    relax_sensors()
     default_deadline = config.get("solver.default.deadline.ms")
     cc = CruiseControl(
         load_monitor, executor, task_runner=task_runner,

@@ -37,6 +37,7 @@ from cruise_control_tpu.common.metrics import registry as _metric_registry
 from cruise_control_tpu.compilesvc.buckets import geometric_bucket
 from cruise_control_tpu.model.builder import ClusterModel
 from cruise_control_tpu.model.state import (
+    BROKER_DELTA_FIELDS,
     ClusterDelta,
     ClusterMeta,
     ClusterState,
@@ -225,6 +226,20 @@ class ResidentModelService:
             d.perm = perm
             st, pl = apply_deltas(st, pl, d, slots, 1)
             st.valid.block_until_ready()
+        # The broker-axis-only kernel (liveness flips / capacity edits ride a
+        # tiny dedicated scatter, not the replica slot ladder): warm it at
+        # the same broker-slot width _apply will use for this bucket.
+        b_slots = max(1, min(self.slot_floor, pad_b))
+        st, pl = zeros()
+        d = empty_delta()
+        d.broker_idx = np.zeros(1, dtype=np.int32)
+        shapes = {"capacity": (1, NUM_RESOURCES),
+                  "disk_capacity": (1, num_disks), "disk_alive": (1, num_disks)}
+        d.broker_updates = {
+            name: np.zeros(shapes.get(name, (1,)), dtype)
+            for name, dtype in BROKER_DELTA_FIELDS}
+        st, pl = apply_deltas(st, pl, d, slots, b_slots)
+        st.valid.block_until_ready()
 
     # ----------------------------------------------------------------- private
 

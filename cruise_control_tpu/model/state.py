@@ -351,6 +351,23 @@ def _apply_delta_scatter(state, placement, r_idx, r_upd, b_idx, b_upd):
     return _scatter_body(state, placement, r_idx, r_upd, b_idx, b_upd)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _apply_broker_delta_scatter(state, b_idx, b_upd):
+    """Broker-axis-only scatter: liveness flips, capacity edits, logdir
+    failures.  These deltas touch none of the replica-axis tensors, so they
+    get a dedicated tiny kernel — no replica-slot padding buffers, no
+    placement donation, and a shape family keyed only by the broker slot
+    bucket instead of riding the replica slot ladder."""
+    sb = lambda arr, key: arr.at[b_idx].set(b_upd[key], mode="drop")
+    return state.replace(
+        capacity=sb(state.capacity, "capacity"),
+        alive=sb(state.alive, "alive"),
+        new_broker=sb(state.new_broker, "new_broker"),
+        disk_capacity=sb(state.disk_capacity, "disk_capacity"),
+        disk_alive=sb(state.disk_alive, "disk_alive"),
+    )
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _apply_delta_perm_scatter(state, placement, perm, r_idx, r_upd, b_idx,
                               b_upd):
@@ -401,6 +418,13 @@ def apply_deltas(
     """
     rp = state.num_replicas_padded
     bp = state.num_brokers_padded
+    if (delta.perm is None and delta.replica_idx.shape[0] == 0
+            and delta.broker_updates):
+        # Broker-only delta (liveness/capacity edits): skip the replica-slot
+        # ladder entirely — the placement is untouched and returned as-is.
+        b_idx, b_upd = _pad_updates(delta.broker_idx, delta.broker_updates,
+                                    pad_broker_updates_to, bp)
+        return _apply_broker_delta_scatter(state, b_idx, b_upd), placement
     r_idx, r_upd = _pad_updates(delta.replica_idx, delta.replica_updates,
                                 pad_replica_updates_to, rp)
     b_idx, b_upd = _pad_updates(delta.broker_idx, delta.broker_updates,

@@ -118,6 +118,14 @@ class ConvergenceRecorder:
                 "rounds": int(gc.get("rounds", 0)),
                 "moves": int(gc.get("moves", 0)),
             }
+            # Relax-vs-greedy telemetry: solves that took the convex-
+            # relaxation fast path report its wall time and how many greedy
+            # repair rounds the rounded warm start still needed.
+            if "relax_ms" in gc:
+                entry["relax_ms"] = float(gc["relax_ms"])
+                entry["repair_rounds"] = int(gc.get("repair_rounds", 0))
+                if gc.get("relax_fallback"):
+                    entry["relax_fallback"] = True
             if curve is not None:
                 entry["stats"] = curve_stats(curve,
                                              float(gc.get("metric_before", 0.0)))

@@ -101,7 +101,12 @@ def collect_live(timeout_s: float = 90.0):
 
     cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
                                "partition.metrics.window.ms": 600,
-                               "trace.enabled": True})
+                               "trace.enabled": True,
+                               # Relaxation ON so the /proposals run below
+                               # EXERCISES the Solver.relax.* sensors (the
+                               # distribution goal takes the relax→repair
+                               # path), not just registers them at boot.
+                               "solver.relaxation.enabled": True})
     app = build_app(cfg, port=0)
     app.cc.start_up()
     app.start()

@@ -1,0 +1,222 @@
+"""Convex-relaxation fast path: eligibility registry, flag-off bitwise
+parity + cache-key discipline (the PR-10 segmented-kernel pattern), the
+relax+repair soundness contract through the verifier, lane-batch parity,
+the servlet budget gate (cancel-only relaxes, deadline stays greedy), and
+the warmup-daemon CPU compile smoke for the relax executable."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import GoalOptimizer
+from cruise_control_tpu.analyzer import relax as relax_mod
+from cruise_control_tpu.analyzer import solver as solver_mod
+from cruise_control_tpu.analyzer.goals.registry import (
+    RELAX_ELIGIBLE_GOALS,
+    is_relax_eligible,
+)
+from cruise_control_tpu.common.metrics import registry
+from cruise_control_tpu.testing import deterministic as det
+from cruise_control_tpu.testing.verifier import verify_placement
+
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+
+
+@pytest.fixture(scope="module")
+def snapshot():
+    return det.unbalanced2().freeze(pad_replicas_to=64, pad_brokers_to=8)
+
+
+@pytest.fixture(autouse=True)
+def restore_relaxation():
+    """Every test leaves the process-wide flag where it found it."""
+    prev_on = relax_mod.relaxation_enabled()
+    prev = relax_mod.relaxation_params()
+    yield
+    relax_mod.set_relaxation(prev_on, iterations=prev[0], candidates=prev[1],
+                             waves=prev[2], tolerance=prev[3])
+
+
+def _relax_keys(solver):
+    return {k for k in solver._round_cache
+            if isinstance(k, tuple) and k and k[0] == "relax"}
+
+
+# ------------------------------------------------------------- eligibility
+
+
+def test_eligibility_registry():
+    """The relax family is exactly the resource/count-distribution goals;
+    rack/capacity/swap-based and kafka_assigner goals never take the path."""
+    assert set(RELAX_ELIGIBLE_GOALS) == {
+        "ReplicaDistributionGoal",
+        "DiskUsageDistributionGoal",
+        "NetworkInboundUsageDistributionGoal",
+        "NetworkOutboundUsageDistributionGoal",
+        "CpuUsageDistributionGoal",
+        "LeaderReplicaDistributionGoal",
+    }
+    assert is_relax_eligible("ReplicaDistributionGoal")
+    # Fully-qualified reference names resolve to the bare class name.
+    assert is_relax_eligible("com.linkedin.kafka.cruisecontrol.analyzer."
+                             "goals.ReplicaDistributionGoal")
+    assert not is_relax_eligible("RackAwareGoal")
+    # kafka_assigner inherits from ResourceDistributionGoal but opts OUT.
+    assert not is_relax_eligible("KafkaAssignerDiskUsageDistributionGoal")
+    assert not is_relax_eligible("NoSuchGoal")
+
+
+# ------------------------------------------- bitwise fall-through (PR 10)
+
+
+def test_off_bitwise_equals_today_and_cache_keys(snapshot):
+    """Acceptance: with the flag off, NO relax executables exist and the
+    solve is byte-identical to today's solver; turning the flag on adds
+    only ``("relax", ...)`` keys; turning it back off reuses the original
+    executables untouched and reproduces the original result bitwise."""
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver()
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver)
+
+    res_off = opt.optimizations(state, placement, meta)
+    keys_off = set(solver._round_cache)
+    assert not _relax_keys(solver)
+
+    relax_mod.set_relaxation(True)
+    res_on = opt.optimizations(state, placement, meta)
+    new = set(solver._round_cache) - keys_off
+    assert new and all(k[0] == "relax" for k in new)
+    assert keys_off <= set(solver._round_cache)  # off-path entries untouched
+    assert not res_on.goal_infos[0].relaxed      # capacity goal: ineligible
+    assert res_on.goal_infos[1].relaxed
+
+    keys_on = set(solver._round_cache)
+    relax_mod.set_relaxation(False)
+    res_off2 = opt.optimizations(state, placement, meta)
+    assert set(solver._round_cache) == keys_on   # off run builds nothing new
+    assert all(not i.relaxed for i in res_off2.goal_infos)
+    for name in ("broker", "disk", "is_leader"):
+        assert np.array_equal(
+            np.asarray(getattr(res_off2.final_placement, name)),
+            np.asarray(getattr(res_off.final_placement, name))), name
+    for a, b in zip(res_off2.goal_infos, res_off.goal_infos):
+        assert (a.rounds, a.moves_applied, a.violated_brokers_after) == \
+               (b.rounds, b.moves_applied, b.violated_brokers_after)
+
+
+def test_ineligible_stack_untouched_when_on(snapshot):
+    """A stack with no eligible goal builds no relax executables even with
+    the flag ON, and its result matches the flag-off solve bitwise."""
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver()
+    opt = GoalOptimizer(goal_names=["RackAwareGoal", "ReplicaCapacityGoal"],
+                        solver=solver)
+    res_off = opt.optimizations(state, placement, meta)
+    keys_off = set(solver._round_cache)
+
+    relax_mod.set_relaxation(True)
+    res_on = opt.optimizations(state, placement, meta)
+    assert set(solver._round_cache) == keys_off
+    assert all(not i.relaxed for i in res_on.goal_infos)
+    for name in ("broker", "disk", "is_leader"):
+        assert np.array_equal(
+            np.asarray(getattr(res_on.final_placement, name)),
+            np.asarray(getattr(res_off.final_placement, name))), name
+
+
+# ------------------------------------------------------ relax + repair
+
+
+def test_relax_repair_sound_and_sensors(snapshot):
+    """The relax→round→repair pass is a drop-in: the placement passes the
+    full verifier, the info is re-anchored at the pre-relax state, and the
+    ``Solver.relax.*`` sensors record the attempt."""
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver()
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver)
+    relax_mod.relax_sensors()
+    a0 = registry().counter(relax_mod.ATTEMPTS_SENSOR).count
+
+    relax_mod.set_relaxation(True)
+    res = opt.optimizations(state, placement, meta)
+    info = res.goal_infos[1]
+    assert info.relaxed
+    assert info.relax_ms >= 0.0
+    assert info.repair_rounds == info.rounds
+    assert registry().counter(relax_mod.ATTEMPTS_SENSOR).count == a0 + 1
+    fails = verify_placement(state, placement, meta, res.final_placement,
+                             goal_infos=res.goal_infos)
+    assert not fails, [str(f) for f in fails]
+
+
+def test_batch_lanes_relax_parity(snapshot):
+    """What-if lanes with the flag on compile the vmapped relax kernel and
+    end no worse than pure greedy: every lane still evacuates fully and
+    the violated-broker total does not regress."""
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver()
+    opt = GoalOptimizer(goal_names=GOALS, solver=solver)
+    sets = [[0], [1]]
+    res_off = opt.batch_remove_scenarios(state, placement, meta, sets,
+                                         num_candidates=16)
+    assert not _relax_keys(solver)
+
+    relax_mod.set_relaxation(True)
+    res_on = opt.batch_remove_scenarios(state, placement, meta, sets,
+                                        num_candidates=16)
+    assert _relax_keys(solver)                   # lane kernel compiled (-X)
+    assert int(res_on.stranded_after.sum()) == 0
+    assert (int(res_on.violated_after.sum())
+            <= int(res_off.violated_after.sum()))
+    for s in range(res_on.num_scenarios):
+        assert res_on.balancedness(s) >= res_off.balancedness(s) - 1e-6
+
+
+def test_budget_gate_cancel_only_relaxes_deadline_stays_greedy(snapshot):
+    """The service path always carries a cancel-only ``SolveBudget`` (every
+    servlet operation has a cancellation token), so the gate must be on
+    ``segmented``, not budget-is-None: cancel-only budgets take the fast
+    path, deadline (segmented) budgets stay pure greedy."""
+    import threading
+
+    from cruise_control_tpu.analyzer.budget import SolveBudget
+
+    state, placement, meta = snapshot
+    solver = solver_mod.GoalSolver()
+    opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"], solver=solver)
+    relax_mod.set_relaxation(True)
+
+    cancel_only = SolveBudget(cancel_event=threading.Event())
+    assert not cancel_only.segmented
+    res = opt.optimizations(state, placement, meta, budget=cancel_only)
+    assert res.goal_infos[0].relaxed
+    assert _relax_keys(solver)
+
+    keys = set(solver._round_cache)
+    deadline = SolveBudget(deadline_ms=600_000.0)
+    assert deadline.segmented
+    res2 = opt.optimizations(state, placement, meta, budget=deadline)
+    assert not res2.goal_infos[0].relaxed
+    assert _relax_keys(solver) <= keys           # deadline built no relax
+
+
+# --------------------------------------------------- warmup daemon smoke
+
+
+def test_warmup_daemon_compiles_relax_kernel_cpu():
+    """Satellite: the relax executable compiles on JAX_PLATFORMS=cpu inside
+    the existing warmup-daemon ladder — the ``("relax", goals)`` task is
+    registered and, run synchronously, leaves exactly one relax executable
+    per eligible goal in the solver cache."""
+    from tests.test_facade import build_stack
+
+    relax_mod.set_relaxation(True)
+    cc, _, _ = build_stack()
+    cc.default_goals = list(GOALS)
+    daemon = cc._build_warmup_daemon()
+    tasks = dict(daemon._tasks)
+    key = ("relax", tuple(cc.default_goals))
+    assert key in tasks
+    before = _relax_keys(cc.optimizer.solver)
+    tasks[key]()                                 # the ladder task, inline
+    after = _relax_keys(cc.optimizer.solver)
+    assert len(after - before) == 1              # one eligible goal in stack
