@@ -161,6 +161,13 @@ def _enable_trace() -> None:
     itself) right before ``run``."""
     from cruise_control_tpu.obsvc.tracer import tracer
     tracer().configure(enabled=True, ring_size=64)
+    # Memory observatory in FULL analysis mode: every fresh compile stashes
+    # its Lowered, and _emit's finalize_full() AOT-recompiles once per
+    # executable family OUTSIDE the timed regions, so each row's
+    # peak_bytes / temp_bytes come from XLA's own buffer assignment without
+    # inflating cold-compile measurements.
+    from cruise_control_tpu.obsvc.memory import memory_ledger
+    memory_ledger().configure(enabled=True, analysis_mode="full")
     if "--convergence" in sys.argv:
         from cruise_control_tpu.analyzer.solver import set_round_recording
         from cruise_control_tpu.obsvc.convergence import convergence
@@ -263,6 +270,14 @@ def _emit(metric: str, seconds: float, backend: str, **extra) -> dict:
         row["split_ms"] = _split_ms(roll)
         if "--trace" in sys.argv:
             row["trace"] = roll
+    # Worst-case executable memory across every cost-ledger row so far —
+    # cumulative, not drained: a row's bytes answer "what must fit in HBM
+    # to run everything up to and including this config".
+    from cruise_control_tpu.obsvc.memory import cost_ledger
+    cost_ledger().finalize_full()
+    mem = cost_ledger().maxima()
+    row["peak_bytes"] = mem["peak_bytes"]
+    row["temp_bytes"] = mem["temp_bytes"]
     if "--convergence" in sys.argv:
         from cruise_control_tpu.obsvc.convergence import convergence
         recs = convergence().drain()

@@ -198,6 +198,10 @@ class BatchScenarioResult:
     # only the goal prefix that actually ran; every lane's placement is the
     # anytime result after that prefix.
     preempted: bool = False
+    # Memory headroom guard refused the dispatch: no goal ran, every lane
+    # returns its seed placement, stranded_after is -1 (unknown) so no
+    # scenario reads as succeeded.  Degraded-style tagging, never a crash.
+    memory_refused: bool = False
 
     @property
     def num_scenarios(self) -> int:
@@ -668,6 +672,8 @@ class GoalOptimizer:
 
         import jax
 
+        from cruise_control_tpu.obsvc.memory import memory_ledger
+
         s_n = len(scenario_sets)
         svc = compile_service()
         lane_key = None
@@ -678,6 +684,33 @@ class GoalOptimizer:
                                     int(np.asarray(alive_s).shape[1]),
                                     num_candidates)
             plan = svc.plan_lanes(s_n, lane_key)
+            # Headroom guard: the cost ledger projects peak bytes for the
+            # plan's widest lane block; a projection over the headroom
+            # fraction of the device budget re-chunks onto narrower widths,
+            # and when nothing fits the dispatch is refused outright.
+            c = min(num_candidates, state.num_replicas_padded)
+            plan, refused = memory_ledger().guard_lane_plan(
+                plan, s_n, f"R{state.num_replicas_padded}-C{c}",
+                svc.policy.lane_ladder,
+                compiled_widths=svc.compiled_lane_widths(lane_key))
+            if refused:
+                import jax
+                seed = placement if warm_start is None else warm_start
+                placement_s = jax.tree_util.tree_map(
+                    lambda x: np.broadcast_to(np.asarray(x)[None],
+                                              (s_n,) + x.shape), seed)
+                return BatchScenarioResult(
+                    scenario_sets=[list(map(int, ids))
+                                   for ids in scenario_sets],
+                    goal_names=[],
+                    violated_after=np.zeros((s_n, 0), np.int32),
+                    moves=np.zeros((s_n, 0), np.int32),
+                    rounds=np.zeros((s_n, 0), np.int32),
+                    stranded_after=np.full(s_n, -1, np.int32),
+                    final_placements=placement_s,
+                    preempted=True,
+                    memory_refused=True,
+                )
 
         if plan is None or plan_is_identity(plan, s_n):
             out = self._run_lane_block(gctx, state, placement, goals,
@@ -745,6 +778,34 @@ class GoalOptimizer:
     def _run_lane_block(self, gctx, state, placement, goals, num_candidates,
                         alive_s, excl_move_s, excl_lead_s, warm_start=None,
                         budget=None):
+        """Ledgered wrapper over :meth:`_run_lane_block_impl`: the block's
+        broadcast lane tensors (per-lane masks + seed placements) are the
+        transient device-buffer bill of a what-if batch — posted to the
+        ``lane-batch`` subsystem for the dispatch's lifetime."""
+        from cruise_control_tpu.obsvc.memory import (SUBSYS_LANES,
+                                                     measure_bytes,
+                                                     memory_ledger)
+
+        ledger = memory_ledger()
+        lane_bytes = 0
+        if ledger.enabled:
+            s_n = int(np.asarray(alive_s).shape[0])
+            seed = placement if warm_start is None else warm_start
+            lane_bytes = (measure_bytes((alive_s, excl_move_s, excl_lead_s))
+                          + s_n * measure_bytes(seed))
+            ledger.post(SUBSYS_LANES, lane_bytes, kind="alloc")
+        try:
+            return self._run_lane_block_impl(
+                gctx, state, placement, goals, num_candidates, alive_s,
+                excl_move_s, excl_lead_s, warm_start=warm_start,
+                budget=budget)
+        finally:
+            if ledger.enabled:
+                ledger.post(SUBSYS_LANES, lane_bytes, kind="free")
+
+    def _run_lane_block_impl(self, gctx, state, placement, goals,
+                             num_candidates, alive_s, excl_move_s,
+                             excl_lead_s, warm_start=None, budget=None):
         """One vmapped solve per goal over a block of lanes; returns host-local
         (rounds[S,G], moves[S,G], violated[S,G], stranded[S], placements).
 

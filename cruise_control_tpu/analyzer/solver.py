@@ -934,8 +934,13 @@ class _CompileTracked:
                  else not self._ever_called)
         self._ever_called = True
         if fresh:
-            _compile_telemetry().record_compile(
-                self._label_fn(*args, **kwargs), elapsed)
+            label = self._label_fn(*args, **kwargs)
+            _compile_telemetry().record_compile(label, elapsed)
+            # Cost-ledger hook: host-side re-lower on abstract avals (the
+            # jit dispatch cache is untouched), exception-isolated so
+            # accounting can never break a solve.
+            from cruise_control_tpu.obsvc.memory import memory_ledger
+            memory_ledger().observe_compile(label, self._fn, args, kwargs)
         return out
 
     def __getattr__(self, name):

@@ -27,6 +27,7 @@ from cruise_control_tpu.common.exceptions import ConfigError
 from cruise_control_tpu.config.config_def import (
     ConfigDef,
     ConfigType,
+    in_validator,
     load_properties,
     range_validator,
 )
@@ -352,6 +353,45 @@ def _trace_def() -> ConfigDef:
              range_validator(0.0001),
              doc="burn rate (violating fraction / error budget) at or above "
                  "which a window counts as burning")
+    d.define("slo.memory.utilization.max", ConfigType.DOUBLE, 0.9,
+             range_validator(0.0001, 1.0),
+             doc="memory-headroom objective: the device-buffer ledger's "
+                 "tracked utilization (Memory.device-utilization, live bytes "
+                 "/ device budget) must stay below this fraction")
+    return d
+
+
+def _memory_def() -> ConfigDef:
+    """Device-memory observatory keys (no reference analog — the reference
+    JVM delegates memory pressure to the garbage collector; on an
+    accelerator, HBM occupancy is a first-class scheduling input)."""
+    d = ConfigDef()
+    d.define("memory.enabled", ConfigType.BOOLEAN, True,
+             doc="run the device-buffer ledger (per-subsystem live-bytes "
+                 "accounting, GET /memory, Memory.* sensors) and the "
+                 "per-executable compile-cost ledger.  Host-side only: no "
+                 "traced code changes, every jit cache key and executable "
+                 "is byte-identical with the ledger off")
+    d.define("memory.headroom.fraction", ConfigType.DOUBLE, 0.9,
+             range_validator(0.0001, 1.0),
+             doc="lane-dispatch guard ceiling: a what-if batch whose "
+                 "projected peak bytes exceed this fraction of the device "
+                 "budget is re-chunked onto narrower lane widths, or refused "
+                 "(degraded-style tagging) when no ladder width fits")
+    d.define("memory.device.budget.bytes", ConfigType.LONG, 0,
+             range_validator(0),
+             doc="device memory budget the headroom guard divides by; "
+                 "0 = take the backend-reported bytes_limit from "
+                 "device.memory_stats() (XLA:CPU reports none, leaving the "
+                 "guard inert unless this override is set)")
+    d.define("memory.analysis.mode", ConfigType.STRING, "lowered",
+             in_validator("off", "lowered", "full"),
+             doc="per-executable cost analysis depth on each fresh XLA "
+                 "compile: 'off' disables rows; 'lowered' (default) re-lowers "
+                 "on abstract avals for flops/bytes-accessed plus arg/out "
+                 "sizes (~ms, once per bucket label); 'full' additionally "
+                 "AOT-compiles for temp/generated-code bytes and true peak "
+                 "(a second XLA compile per family — bench/profile opt-in)")
     return d
 
 
@@ -547,7 +587,7 @@ class CruiseControlConfig:
         self.definition = (_analyzer_def().merge(_monitor_def())
                            .merge(_executor_def()).merge(_anomaly_def())
                            .merge(_compile_def()).merge(_model_def())
-                           .merge(_trace_def())
+                           .merge(_trace_def()).merge(_memory_def())
                            .merge(_fuzz_def()).merge(_resilience_def())
                            .merge(_solver_def()).merge(_webserver_def()))
         props = dict(props or {})

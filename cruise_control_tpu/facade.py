@@ -1108,6 +1108,7 @@ class CruiseControl:
         """GET /state aggregation (CruiseControlState.java)."""
         runner_state = (self.task_runner.state.value
                         if self.task_runner is not None else "NOT_STARTED")
+        from cruise_control_tpu.obsvc.memory import memory_ledger
         return {
             "MonitorState": self.load_monitor.state(runner_state).to_dict(),
             "ExecutorState": self.executor.state_summary(),
@@ -1119,6 +1120,7 @@ class CruiseControl:
                 "residentModel": self.resident.stats(),
                 "convergence": _convergence().state_summary(),
                 "activeSolves": self.active_solves(),
+                "memoryState": memory_ledger().state_summary(),
             },
         }
 

@@ -317,6 +317,69 @@ def convergence_curve_coherent(m: Materialized) -> List[str]:
     return out
 
 
+def memory_ledger_balanced(m: Materialized) -> List[str]:
+    """The device-buffer ledger's books close over a full resident-model
+    lifecycle on this scenario: a pinned freeze posts live bytes, a
+    journalled mutation takes the donation path without moving the total,
+    release/invalidate drain pins and bytes back to zero, no post ever
+    drives a subsystem negative (imbalance counter), and the tracked total
+    stays within tolerance of backend-reported stats where the backend
+    exposes them.  Runs against a scenario-private ledger so fuzz processes
+    with ``memory.enabled=false`` still exercise the accounting."""
+    from cruise_control_tpu.model.builder import builder_from_snapshot
+    from cruise_control_tpu.model.resident import ResidentModelService
+    from cruise_control_tpu.obsvc.memory import (
+        SUBSYS_RESIDENT, DeviceMemoryLedger, memory_ledger, set_memory_ledger)
+
+    prev = memory_ledger()
+    ledger = DeviceMemoryLedger()
+    ledger.configure(enabled=True, analysis_mode="off")
+    set_memory_ledger(ledger)
+    imb0 = ledger.imbalance_count
+    out: List[str] = []
+    try:
+        svc = ResidentModelService(enabled=True)
+        cm = builder_from_snapshot(m.state, m.placement, m.meta)
+        pad = (m.scenario.pad_replicas_to, m.scenario.pad_brokers_to)
+        svc.snapshot(cm, lambda r, b: pad, pin=True)
+        frozen = ledger.live_bytes(SUBSYS_RESIDENT)
+        if frozen <= 0:
+            out.append("pinned full freeze posted no resident live bytes")
+        if ledger.pins(SUBSYS_RESIDENT) != 1:
+            out.append(f"pin count after pinned snapshot: "
+                       f"{ledger.pins(SUBSYS_RESIDENT)} != 1")
+        svc.release()
+        # One journalled load edit → the next snapshot rides the delta
+        # (donation) path: an event, not a byte movement.
+        (t, p), _ = next(iter(cm.partitions().items()))
+        rs = cm.partition(t, p)
+        if rs:
+            cm.set_replica_load(t, p, rs[0].broker_id,
+                                np.full(4, 7.0, dtype=np.float64))
+        svc.snapshot(cm, lambda r, b: pad)
+        if ledger.live_bytes(SUBSYS_RESIDENT) != frozen:
+            out.append(f"delta apply moved resident bytes: {frozen} -> "
+                       f"{ledger.live_bytes(SUBSYS_RESIDENT)}")
+        svc.invalidate("fuzz memory_ledger_balanced")
+        if ledger.live_bytes() != 0:
+            out.append(f"live bytes after invalidate: {ledger.live_bytes()}")
+        ev = ledger.events()
+        if ev.get("alloc", 0) != ev.get("free", 0):
+            out.append(f"alloc/free events unbalanced: {ev}")
+        if ev.get("pin", 0) != ev.get("release", 0):
+            out.append(f"pin/release events unbalanced: {ev}")
+        if rs and not ev.get("donate"):
+            out.append("delta apply posted no donation event")
+        if ledger.imbalance_count != imb0:
+            out.append(f"{ledger.imbalance_count - imb0} post imbalances "
+                       "(a free exceeded tracked bytes or a release had "
+                       "no pin)")
+        out.extend(ledger.verify_balanced())
+    finally:
+        set_memory_ledger(prev)
+    return out
+
+
 # --------------------------------------------------------------------------
 # kind-specific invariants
 # --------------------------------------------------------------------------
@@ -484,6 +547,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "convergence_curve_coherent": convergence_curve_coherent,
     "partial_solve_safe": partial_solve_safe,
     "relaxation_sound": relaxation_sound,
+    "memory_ledger_balanced": memory_ledger_balanced,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

@@ -51,7 +51,7 @@ BASE_INVARIANTS: Tuple[str, ...] = (
     "hard_goals_never_worsen", "soft_goals_no_regression",
     "proposals_executable", "load_conservation",
     "resident_delta_equivalence", "convergence_curve_coherent",
-    "partial_solve_safe", "relaxation_sound",
+    "partial_solve_safe", "relaxation_sound", "memory_ledger_balanced",
 )
 
 # Shared padded shapes for the smoke profile (see module docstring).

@@ -46,6 +46,8 @@ from cruise_control_tpu.model.state import (
     device_put_state,
     empty_delta,
 )
+from cruise_control_tpu.obsvc.memory import (SUBSYS_RESIDENT, measure_bytes,
+                                             memory_ledger)
 from cruise_control_tpu.obsvc.tracer import tracer as _tracer
 
 LOG = logging.getLogger(__name__)
@@ -115,6 +117,8 @@ class ResidentModelService:
         with self.lock:
             if self._entry is None:
                 return
+            memory_ledger().post(SUBSYS_RESIDENT,
+                                 self._entry.get("nbytes", 0), kind="free")
             self._entry = None
             self._invalidations.inc()
             self._invalidation_reasons[reason] = (
@@ -165,11 +169,14 @@ class ResidentModelService:
                 out = self._full_freeze(builder, bucket)
             if pin:
                 self._pins += 1
+                memory_ledger().post(SUBSYS_RESIDENT, 0, kind="pin")
             return out
 
     def release(self) -> None:
         """Drop a ``pin=True`` snapshot's pin; lets pending deltas donate."""
         with self._cond:
+            if self._pins > 0:
+                memory_ledger().post(SUBSYS_RESIDENT, 0, kind="release")
             self._pins = max(0, self._pins - 1)
             self._cond.notify_all()
 
@@ -270,6 +277,11 @@ class ResidentModelService:
         entry.update(state=state, placement=placement, meta=meta,
                      version=builder.version, chain=entry["chain"] + 1)
         self._delta_applies.inc()
+        # Donation: apply_deltas donated (deleted) the old buffers and
+        # produced same-shaped replacements — net zero live bytes; the
+        # ledger counts the event without moving the subsystem total.
+        memory_ledger().post(SUBSYS_RESIDENT, entry.get("nbytes", 0),
+                             kind="donate")
         return state, placement, meta
 
     def _full_freeze(self, builder: ClusterModel, bucket: Tuple[int, int],
@@ -285,7 +297,16 @@ class ResidentModelService:
             state.valid.block_until_ready()
         self._full_freezes.inc()
         if self.enabled:
+            nbytes = measure_bytes((state, placement))
+            if self._entry is not None:
+                # Replacing the pool entry: the old buffers are unreferenced
+                # once in-flight pinned solves drain.
+                memory_ledger().post(SUBSYS_RESIDENT,
+                                     self._entry.get("nbytes", 0),
+                                     kind="free")
+            memory_ledger().post(SUBSYS_RESIDENT, nbytes, kind="alloc")
             self._entry = dict(builder=builder, bucket=bucket, state=state,
                                placement=placement, meta=meta,
-                               version=builder.version, chain=0)
+                               version=builder.version, chain=0,
+                               nbytes=nbytes)
         return state, placement, meta

@@ -12,11 +12,14 @@ Public surface:
   curves; disabled until ``trace.solver.rounds=true``).
 * :func:`history` — the sensor history sampler (bounded per-sensor
   time-series rings; on by default, ``obs.history.enabled``).
+* :func:`memory_ledger` — the device-buffer & executable-cost ledgers
+  (``memory.enabled``; GET /memory, ``Memory.*`` sensors, the lane-dispatch
+  headroom guard).
 * :mod:`~cruise_control_tpu.obsvc.slo` — burn-rate SLO evaluation over the
   history rings, feeding ``SloViolationAnomaly`` into the detector.
 * :mod:`~cruise_control_tpu.obsvc.profiler` — ``POST /profile`` captures.
-* :func:`configure` — apply ``trace.*`` / ``obs.*`` / ``slo.*`` config keys
-  at service build time.
+* :func:`configure` — apply ``trace.*`` / ``obs.*`` / ``slo.*`` /
+  ``memory.*`` config keys at service build time.
 """
 
 from __future__ import annotations
@@ -24,11 +27,15 @@ from __future__ import annotations
 from cruise_control_tpu.obsvc.audit import AuditLog, audit_log
 from cruise_control_tpu.obsvc.convergence import ConvergenceRecorder, convergence
 from cruise_control_tpu.obsvc.history import HistoryRecorder, history
+from cruise_control_tpu.obsvc.memory import (DeviceMemoryLedger,
+                                             ExecutableCostLedger,
+                                             cost_ledger, memory_ledger)
 from cruise_control_tpu.obsvc.tracer import Span, Tracer, tracer
 
-__all__ = ["AuditLog", "ConvergenceRecorder", "HistoryRecorder", "Span",
-           "Tracer", "audit_log", "configure", "convergence", "history",
-           "tracer"]
+__all__ = ["AuditLog", "ConvergenceRecorder", "DeviceMemoryLedger",
+           "ExecutableCostLedger", "HistoryRecorder", "Span",
+           "Tracer", "audit_log", "configure", "convergence", "cost_ledger",
+           "history", "memory_ledger", "tracer"]
 
 
 def configure(config) -> Tracer:
@@ -40,6 +47,7 @@ def configure(config) -> Tracer:
     # Lazy: solver imports obsvc.tracer mid-module, so obsvc cannot import
     # the solver at module level without closing the cycle.
     from cruise_control_tpu.analyzer import solver as _solver
+    from cruise_control_tpu.obsvc import memory as _memory
     from cruise_control_tpu.obsvc import profiler
 
     tr = tracer()
@@ -52,6 +60,8 @@ def configure(config) -> Tracer:
     _solver.set_round_recording(record_rounds)
     convergence().configure(enabled=record_rounds,
                             ring_size=int(config.get("trace.solver.ring.size")))
+
+    _memory.configure(config)
 
     hist = history()
     hist.configure(

@@ -8,6 +8,9 @@ into bounded per-sensor time-series rings:
 
 - one scalar per sensor per sample — counters record ``count``, timers
   record ``p99_ms`` and gauges their value — keeping a ring entry tiny;
+- each timer additionally feeds ``<name>.p50_ms`` / ``<name>.max_ms``
+  sibling rings (the bare name stays p99 — burn-rate windows and existing
+  dashboards read it unchanged);
 - rings are bounded (``obs.history.ring.size``), oldest samples evicted;
 - the sampler's own liveness is observable: every snapshot bumps the
   ``Obs.history-samples`` counter.
@@ -27,6 +30,11 @@ from typing import Any, Dict, List, Optional
 from cruise_control_tpu.common.metrics import registry
 
 SAMPLES_SENSOR = "Obs.history-samples"
+
+# Extra per-timer quantile rings recorded under dotted sibling names —
+# ring names, not registry sensors, so they stay invisible to the
+# sensor-drift guard and to SLO patterns anchored on ``*-timer``.
+TIMER_SIBLING_STATS = ("p50_ms", "max_ms")
 
 
 def _scalar(record: Dict[str, Any]) -> Optional[float]:
@@ -84,13 +92,22 @@ class HistoryRecorder:
                 value = _scalar(record)
                 if value is None:
                     continue
-                ring = self._series.get(name)
-                if ring is None:
-                    ring = self._series[name] = deque(maxlen=self.ring_size)
-                ring.append((ts_ms, value))
+                self._append(name, ts_ms, value)
                 n += 1
+                if record.get("type") == "timer":
+                    for stat in TIMER_SIBLING_STATS:
+                        v = record.get(stat)
+                        if isinstance(v, (int, float)):
+                            self._append(f"{name}.{stat}", ts_ms, float(v))
         self._samples_counter.inc()
         return n
+
+    def _append(self, name: str, ts_ms: float, value: float) -> None:
+        """Caller holds ``self._lock``."""
+        ring = self._series.get(name)
+        if ring is None:
+            ring = self._series[name] = deque(maxlen=self.ring_size)
+        ring.append((ts_ms, value))
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -143,6 +160,24 @@ class HistoryRecorder:
             out = {n: [p for p in pts if p[0] >= since_ms]
                    for n, pts in out.items()}
         return out
+
+    # Bound on glob-query responses: a ``sensor=*`` against a service with
+    # hundreds of rings must not serialize them all by default.
+    DEFAULT_SERIES_LIMIT = 64
+    MAX_SERIES_LIMIT = 1024
+
+    def history_bounded(self, pattern: Optional[str] = None,
+                        since_ms: Optional[float] = None,
+                        limit: int = DEFAULT_SERIES_LIMIT):
+        """:meth:`history` with a bounded series count: at most ``limit``
+        rings (name-sorted, capped at ``MAX_SERIES_LIMIT``); the second
+        return value flags whether matches were dropped."""
+        limit = max(1, min(int(limit), self.MAX_SERIES_LIMIT))
+        out = self.history(pattern=pattern, since_ms=since_ms)
+        if len(out) <= limit:
+            return out, False
+        kept = sorted(out)[:limit]
+        return {n: out[n] for n in kept}, True
 
     def reset(self) -> None:
         with self._lock:

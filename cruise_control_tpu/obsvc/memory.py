@@ -1,0 +1,614 @@
+"""Device-memory & executable-cost observatory (``memory.*``).
+
+The observability stack sees *time* at kernel granularity (compilesvc
+telemetry, spans, convergence curves) but was blind to *bytes*: nothing
+tracked live device-buffer occupancy or per-executable peak/temp memory,
+yet "what fits in HBM, and who owns it" is the gating question for the
+multi-tenant resident pool and multi-device lane sharding.  This module
+adds two host-side ledgers plus a dispatch guard — zero traced code, so
+every jit cache key and executable stays byte-identical to a ledger-free
+build (asserted in tests/test_memory.py):
+
+* :class:`DeviceMemoryLedger` — per-subsystem live-bytes accounting.
+  Subsystems post alloc/free/donate/pin/release events (resident model
+  freezes and donations, lane-batch mask/placement blocks, warmup
+  tensors); totals are reconciled against ``device.memory_stats()``
+  where the backend exposes it (TPU/GPU; XLA:CPU returns None).
+* :class:`ExecutableCostLedger` — per-executable compile-time cost rows
+  keyed by the existing compilesvc bucket labels (``R…-C…[-L…]``).
+  Populated from the solver's compile-detection seam: ``lowered`` mode
+  (service default) re-lowers the jitted function on abstract avals and
+  records ``cost_analysis()`` flops / bytes-accessed plus argument and
+  output sizes; ``full`` mode (bench/profile opt-in) additionally AOT
+  compiles and records ``memory_analysis()`` temp / generated-code
+  bytes.  ``peak_bytes`` is the derived arg+out+temp+generated sum
+  (``CompiledMemoryStats`` exposes no peak field).
+* the **headroom guard** — the lane-chunk planner consults projected
+  peak bytes per lane width and shrinks a what-if batch onto narrower
+  chunks (or refuses the dispatch outright, degraded-style, never a
+  crash) when the projection exceeds ``memory.headroom.fraction`` of
+  the device budget.
+
+Surfaces: ``GET /memory``, ``memoryState`` in ``/state``, ``Memory.*``
+sensors (and thereby the ``/metrics/history`` rings + the
+memory-headroom SLO objective), ``peak_bytes``/``temp_bytes`` columns
+on bench rows and ``scripts/profile_solve.py`` goals.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.common.metrics import registry as _metric_registry
+
+LOG = logging.getLogger(__name__)
+
+# Canonical subsystem names (free-form strings are accepted; these are the
+# ones the stack posts today and the ones docs/MEMORY.md documents).
+SUBSYS_RESIDENT = "resident-model"
+SUBSYS_LANES = "lane-batch"
+SUBSYS_WARMUP = "warmup"
+
+LIVE_BYTES_SENSOR = "Memory.live-bytes"
+UTILIZATION_SENSOR = "Memory.device-utilization"
+DRIFT_SENSOR = "Memory.reconcile-drift-bytes"
+POSTS_SENSOR = "Memory.posts"
+IMBALANCE_SENSOR = "Memory.post-imbalances"
+SHRINKS_SENSOR = "Memory.headroom-shrinks"
+REFUSALS_SENSOR = "Memory.headroom-refusals"
+COST_ROWS_SENSOR = "Memory.cost-rows"
+ANALYSIS_FAILURES_SENSOR = "Memory.analysis-failures"
+
+ANALYSIS_MODES = ("off", "lowered", "full")
+
+
+def measure_bytes(tree: Any) -> int:
+    """Total device-relevant bytes of a pytree: the ``nbytes`` sum over
+    array leaves (jax Arrays and numpy arrays; scalars/None are free).
+    Works on donated/deleted jax Arrays too — shape/dtype metadata
+    outlives the buffer, which is exactly what accounting needs."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            import numpy as np
+            n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        except Exception:   # noqa: BLE001 — exotic leaf: skip, never raise
+            continue
+        total += n
+    return total
+
+
+def _abstractify(tree: Any):
+    """Map concrete array leaves to ShapeDtypeStructs so ``fn.lower`` never
+    touches (possibly donated-and-deleted) device buffers; non-array leaves
+    pass through unchanged so static/python arguments trace as they did."""
+    import jax
+
+    def one(leaf):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            return leaf
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+class ExecutableCostLedger:
+    """Per-executable compile-cost rows, keyed by compilesvc bucket label.
+
+    ``observe_compile`` is called from the solver's compile-detection seam
+    (``_CompileTracked``) AFTER a fresh XLA compile was measured; it is
+    exception-safe and strictly host-side.  Each unique label is analyzed
+    once per mode (re-compiles of the same bucket only bump ``count``), so
+    the bounded analysis bill is one extra trace per executable family."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[str, Dict[str, Any]] = {}
+        self._failures = _metric_registry().counter(ANALYSIS_FAILURES_SENSOR)
+        _metric_registry().settable_gauge(COST_ROWS_SENSOR).set(0)
+
+    # -- write side --------------------------------------------------------
+
+    def observe_compile(self, label: str, fn, args: tuple, kwargs: dict,
+                        mode: str) -> None:
+        if mode == "off":
+            return
+        with self._lock:
+            row = self._rows.get(label)
+            if row is not None and row.get("mode") == mode:
+                row["count"] += 1
+                return
+        try:
+            row = self._analyze(label, fn, args, kwargs, mode)
+        except Exception:   # noqa: BLE001 — observability must never break a solve
+            self._failures.inc()
+            LOG.debug("cost analysis failed for %s", label, exc_info=True)
+            return
+        with self._lock:
+            prev = self._rows.get(label)
+            if prev is not None:
+                row["count"] = prev["count"] + 1
+            self._rows[label] = row
+            _metric_registry().settable_gauge(COST_ROWS_SENSOR).set(
+                len(self._rows))
+
+    def _analyze(self, label: str, fn, args: tuple, kwargs: dict,
+                 mode: str) -> Dict[str, Any]:
+        lowered = fn.lower(*_abstractify(args), **_abstractify(kwargs))
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = cost or {}
+        arg_bytes = measure_bytes(args) + measure_bytes(kwargs)
+        out_bytes = measure_bytes(getattr(lowered, "out_info", None))
+        row: Dict[str, Any] = {
+            "label": label,
+            "mode": mode,
+            "count": 1,
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "arg_bytes": int(arg_bytes),
+            "out_bytes": int(out_bytes),
+            "temp_bytes": None,
+            "generated_code_bytes": None,
+            # Derived peak (CompiledMemoryStats has no peak field): the
+            # arg+out+temp+generated sum.  In ``lowered`` mode temp/code
+            # sizes are unknown, so the peak is the arg+out floor.
+            "peak_bytes": int(arg_bytes + out_bytes),
+        }
+        if mode == "full":
+            # Full mode needs an AOT compile (a second XLA compile of the
+            # family — jit's dispatch cache does not dedupe it).  Deferred:
+            # the Lowered is stashed and ``finalize_full`` pays the compile
+            # outside whatever timed region triggered this observation, so
+            # bench/profile cold-compile measurements stay honest.
+            row["pending"] = True
+            row["_lowered"] = lowered
+        return row
+
+    def finalize_full(self) -> int:
+        """AOT-compile every pending full-mode row, filling temp/generated
+        bytes and the true derived peak.  Returns rows finalized.  Callers
+        (bench/profile emit paths) invoke this OUTSIDE timed regions; a
+        compile failure marks the row non-pending and bumps
+        ``Memory.analysis-failures`` rather than raising."""
+        with self._lock:
+            pending = [(label, row["_lowered"])
+                       for label, row in self._rows.items()
+                       if row.get("pending") and "_lowered" in row]
+        done = 0
+        for label, lowered in pending:
+            update: Dict[str, Any] = {"pending": False}
+            try:
+                mem = lowered.compile().memory_analysis()
+                if mem is not None:
+                    arg = int(getattr(mem, "argument_size_in_bytes", 0))
+                    out = int(getattr(mem, "output_size_in_bytes", 0))
+                    temp = int(getattr(mem, "temp_size_in_bytes", 0))
+                    code = int(getattr(mem, "generated_code_size_in_bytes", 0))
+                    update.update(arg_bytes=arg, out_bytes=out,
+                                  temp_bytes=temp,
+                                  generated_code_bytes=code,
+                                  peak_bytes=arg + out + temp + code)
+            except Exception:   # noqa: BLE001 — accounting never raises
+                self._failures.inc()
+                LOG.debug("full cost analysis failed for %s", label,
+                          exc_info=True)
+            with self._lock:
+                row = self._rows.get(label)
+                if row is not None:
+                    row.update(update)
+                    row.pop("_lowered", None)
+            done += 1
+        return done
+
+    def ingest(self, label: str, row: Dict[str, Any]) -> None:
+        """Direct row insert (tests / replay of captured artifacts)."""
+        with self._lock:
+            self._rows[label] = dict(row, label=label)
+            _metric_registry().settable_gauge(COST_ROWS_SENSOR).set(
+                len(self._rows))
+
+    # -- read side ---------------------------------------------------------
+
+    @staticmethod
+    def _public(row: Dict[str, Any]) -> Dict[str, Any]:
+        # Underscore keys hold non-serializable internals (the stashed
+        # Lowered awaiting finalize_full) — never exposed.
+        return {k: v for k, v in row.items() if not k.startswith("_")}
+
+    def rows(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: self._public(v) for k, v in sorted(self._rows.items())}
+
+    def row(self, label: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            r = self._rows.get(label)
+            return self._public(r) if r is not None else None
+
+    def peak_for_lanes(self, base_label: str, lanes: int) -> Optional[int]:
+        """Projected peak bytes of ``<base_label>-L<lanes>``: the recorded
+        row when one exists, otherwise a linear rescale from the nearest
+        recorded width of the same family (lane peak is dominated by the
+        per-lane masks/placements/temps, all ∝ lanes).  None with no data —
+        the guard then has no basis to refuse."""
+        exact = self.row(f"{base_label}-L{int(lanes)}")
+        if exact is not None and exact.get("peak_bytes"):
+            return int(exact["peak_bytes"])
+        best: Optional[Tuple[int, int]] = None
+        prefix = f"{base_label}-L"
+        with self._lock:
+            for label, r in self._rows.items():
+                if not label.startswith(prefix) or not r.get("peak_bytes"):
+                    continue
+                tail = label[len(prefix):]
+                if not tail.isdigit():
+                    continue
+                w = int(tail)
+                if best is None or abs(w - lanes) < abs(best[0] - lanes):
+                    best = (w, int(r["peak_bytes"]))
+        if best is None:
+            return None
+        w, peak = best
+        return int(peak * (int(lanes) / max(w, 1)))
+
+    def maxima(self) -> Dict[str, int]:
+        """Worst-case columns across all rows — what a bench row reports
+        (``peak_bytes``/``temp_bytes``) for the executables it exercised."""
+        with self._lock:
+            peaks = [r.get("peak_bytes") or 0 for r in self._rows.values()]
+            temps = [r.get("temp_bytes") or 0 for r in self._rows.values()]
+        return {"peak_bytes": max(peaks, default=0),
+                "temp_bytes": max(temps, default=0)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            _metric_registry().settable_gauge(COST_ROWS_SENSOR).set(0)
+
+
+class DeviceMemoryLedger:
+    """Process-wide device-buffer ledger + dispatch headroom guard.
+
+    Host-side bookkeeping only: subsystems post signed byte events and the
+    ledger maintains per-subsystem live totals (clamped at zero — a free
+    exceeding the tracked allocation bumps ``Memory.post-imbalances``
+    instead of going negative), pin/release balance, and gauges for the
+    history rings.  Disabled (the module default until ``configure`` runs)
+    every entry point is a cheap no-op."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.analysis_mode = "lowered"
+        self.headroom_fraction = 0.9
+        self.budget_override_bytes = 0
+        self.costs = ExecutableCostLedger()
+        self._live: Dict[str, int] = {}
+        self._peak: Dict[str, int] = {}
+        self._pins: Dict[str, int] = {}
+        self._events: Dict[str, int] = {}
+        reg = _metric_registry()
+        self._posts = reg.counter(POSTS_SENSOR)
+        self._imbalances = reg.counter(IMBALANCE_SENSOR)
+        self._shrinks = reg.counter(SHRINKS_SENSOR)
+        self._refusals = reg.counter(REFUSALS_SENSOR)
+        self._live_gauge = reg.settable_gauge(LIVE_BYTES_SENSOR)
+        self._util_gauge = reg.settable_gauge(UTILIZATION_SENSOR)
+        self._drift_gauge = reg.settable_gauge(DRIFT_SENSOR)
+        self._subsys_gauges: Dict[str, Any] = {}
+        self._live_gauge.set(0)
+        self._util_gauge.set(0.0)
+        self._drift_gauge.set(0)
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, enabled: bool, headroom_fraction: float = 0.9,
+                  budget_bytes: int = 0,
+                  analysis_mode: str = "lowered") -> None:
+        if analysis_mode not in ANALYSIS_MODES:
+            raise ValueError(f"memory.analysis.mode must be one of "
+                             f"{ANALYSIS_MODES}, got {analysis_mode!r}")
+        with self._lock:
+            self.enabled = bool(enabled)
+            self.headroom_fraction = float(headroom_fraction)
+            self.budget_override_bytes = int(budget_bytes)
+            self.analysis_mode = analysis_mode
+        if self.enabled:
+            # Materialize the canonical subsystem gauges so the sensor-drift
+            # guard sees Memory.* on a fresh boot, before the first post.
+            for subsys in (SUBSYS_RESIDENT, SUBSYS_LANES, SUBSYS_WARMUP):
+                self._gauge(subsys)
+
+    def _gauge(self, subsystem: str):
+        g = self._subsys_gauges.get(subsystem)
+        if g is None:
+            g = _metric_registry().settable_gauge(
+                f"Memory.{subsystem}.live-bytes")
+            g.set(self._live.get(subsystem, 0))
+            self._subsys_gauges[subsystem] = g
+        return g
+
+    # -- write side --------------------------------------------------------
+
+    def post(self, subsystem: str, nbytes: int, kind: str = "alloc",
+             note: str = "") -> None:
+        """One ledger event.  ``alloc`` adds ``nbytes`` to the subsystem's
+        live total, ``free`` subtracts, ``donate`` records an in-place
+        buffer swap (old freed, equal-size new allocated: net zero by
+        construction), ``pin``/``release`` track refcounts only."""
+        del note
+        if not self.enabled:
+            return
+        nbytes = int(nbytes)
+        with self._lock:
+            self._posts.inc()
+            self._events[kind] = self._events.get(kind, 0) + 1
+            if kind == "pin":
+                self._pins[subsystem] = self._pins.get(subsystem, 0) + 1
+                return
+            if kind == "release":
+                pins = self._pins.get(subsystem, 0) - 1
+                if pins < 0:
+                    pins = 0
+                    self._imbalances.inc()
+                self._pins[subsystem] = pins
+                return
+            if kind == "donate":
+                return      # net-zero by contract; counted, not summed
+            live = self._live.get(subsystem, 0)
+            if kind == "free":
+                nbytes = -nbytes
+            live += nbytes
+            if live < 0:
+                live = 0
+                self._imbalances.inc()
+            self._live[subsystem] = live
+            self._peak[subsystem] = max(self._peak.get(subsystem, 0), live)
+            total = sum(self._live.values())
+        self._gauge(subsystem).set(live)
+        self._live_gauge.set(total)
+        budget = self.device_budget_bytes()
+        if budget:
+            self._util_gauge.set(round(total / budget, 6))
+
+    def observe_compile(self, label: str, fn, args: tuple,
+                        kwargs: dict) -> None:
+        """Compile-time cost hook (called by the solver's compile-detection
+        proxy on each fresh XLA compile).  No-op while disabled."""
+        if not self.enabled:
+            return
+        self.costs.observe_compile(label, fn, args, kwargs,
+                                   self.analysis_mode)
+
+    # -- read side ---------------------------------------------------------
+
+    def live_bytes(self, subsystem: Optional[str] = None) -> int:
+        with self._lock:
+            if subsystem is not None:
+                return self._live.get(subsystem, 0)
+            return sum(self._live.values())
+
+    def pins(self, subsystem: Optional[str] = None) -> int:
+        with self._lock:
+            if subsystem is not None:
+                return self._pins.get(subsystem, 0)
+            return sum(self._pins.values())
+
+    @property
+    def imbalance_count(self) -> int:
+        """Process-lifetime imbalance events (the counter is a registry
+        sensor shared across ledger instances — diff around a scenario)."""
+        return int(self._imbalances.count)
+
+    def events(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._events)
+
+    @staticmethod
+    def backend_memory_stats() -> Optional[Dict[str, int]]:
+        """``device.memory_stats()`` of the first device, or None where the
+        backend does not expose it (XLA:CPU)."""
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats()
+        except Exception:   # noqa: BLE001 — probing must never raise
+            return None
+        if not stats:
+            return None
+        return {k: int(v) for k, v in stats.items()
+                if isinstance(v, (int, float))}
+
+    def device_budget_bytes(self) -> Optional[int]:
+        """The guard's denominator: the configured override when set,
+        otherwise the backend-reported limit, otherwise None (no basis to
+        guard — every dispatch admits)."""
+        if self.budget_override_bytes > 0:
+            return self.budget_override_bytes
+        stats = self.backend_memory_stats()
+        if stats:
+            for key in ("bytes_limit", "bytes_reservable_limit"):
+                if stats.get(key):
+                    return int(stats[key])
+        return None
+
+    def reconcile(self) -> Dict[str, Any]:
+        """Tracked totals vs backend-reported stats.  ``driftBytes`` is
+        backend in-use minus tracked (None without backend stats): the
+        untracked remainder — executables, constants, anything a subsystem
+        does not post — not an error unless it trends."""
+        tracked = self.live_bytes()
+        stats = self.backend_memory_stats()
+        drift = None
+        if stats and "bytes_in_use" in stats:
+            drift = int(stats["bytes_in_use"]) - tracked
+        self._drift_gauge.set(drift if drift is not None else 0)
+        return {"trackedBytes": tracked, "backend": stats,
+                "driftBytes": drift}
+
+    # -- dispatch headroom guard -------------------------------------------
+
+    def guard_lane_plan(self, plan: List, s_n: int, base_label: str,
+                        ladder, compiled_widths=()) -> Tuple[List, bool]:
+        """Shrink-or-refuse a lane-chunk plan against projected peak bytes.
+
+        Returns ``(plan, refused)``.  For the widest chunk in ``plan``, the
+        cost ledger projects peak bytes (recorded row, or a rescale from
+        the nearest recorded width); when the projection exceeds
+        ``headroom_fraction × device budget`` the plan is re-chunked at the
+        widest ladder width that fits (``Memory.headroom-shrinks``).  When
+        even the narrowest width does not fit the dispatch is refused
+        (``Memory.headroom-refusals``) — the caller degrades, never
+        crashes.  With no budget, no projection, or the ledger disabled the
+        plan passes through untouched: no evidence, no refusal."""
+        if not self.enabled or not plan:
+            return plan, False
+        budget = self.device_budget_bytes()
+        if not budget:
+            return plan, False
+        limit = self.headroom_fraction * budget
+        width = max(c.size for c in plan)
+        projected = self.costs.peak_for_lanes(base_label, width)
+        if projected is None or projected <= limit:
+            return plan, False
+        widths = sorted({int(w) for w in ladder if int(w) >= 1})
+        fit = None
+        for w in reversed([w for w in widths if w < width]):
+            p = self.costs.peak_for_lanes(base_label, w)
+            if p is not None and p <= limit:
+                fit = w
+                break
+        if fit is None:
+            self._refusals.inc()
+            LOG.warning(
+                "memory headroom guard REFUSED a %d-lane dispatch: projected "
+                "peak %d B > %.0f%% of %d B at every ladder width",
+                s_n, projected, self.headroom_fraction * 100.0, budget)
+            return plan, True
+        from cruise_control_tpu.compilesvc.chunking import plan_lane_chunks
+        self._shrinks.inc()
+        LOG.info(
+            "memory headroom guard shrank a %d-lane dispatch to %d-wide "
+            "chunks (projected peak %d B > %.0f%% of %d B)",
+            s_n, fit, projected, self.headroom_fraction * 100.0, budget)
+        return plan_lane_chunks(s_n, widths, compiled=compiled_widths,
+                                max_chunk=fit), False
+
+    # -- surfaces ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /memory`` body (also ``memoryState`` minus the cost
+        table in ``/state``)."""
+        with self._lock:
+            subsystems = {
+                name: {"liveBytes": self._live.get(name, 0),
+                       "peakBytes": self._peak.get(name, 0),
+                       "pins": self._pins.get(name, 0)}
+                for name in sorted(set(self._live) | set(self._pins)
+                                   | set(self._peak)
+                                   | set(self._subsys_gauges))}
+            events = dict(sorted(self._events.items()))
+        return {
+            "enabled": self.enabled,
+            "analysisMode": self.analysis_mode,
+            "headroomFraction": self.headroom_fraction,
+            "deviceBudgetBytes": self.device_budget_bytes(),
+            "liveBytes": self.live_bytes(),
+            "subsystems": subsystems,
+            "events": events,
+            "guard": {"shrinks": int(self._shrinks.count),
+                      "refusals": int(self._refusals.count)},
+            "reconcile": self.reconcile(),
+            "costs": self.costs.rows(),
+        }
+
+    def state_summary(self) -> Dict[str, Any]:
+        """The compact ``memoryState`` block for ``GET /state``."""
+        snap = self.snapshot()
+        snap.pop("costs", None)
+        snap["costRows"] = len(self.costs.rows())
+        return snap
+
+    def verify_balanced(self, drift_tolerance_fraction: float = 0.5,
+                        ) -> List[str]:
+        """Invariant checks for fuzzsvc ``memory_ledger_balanced``: no
+        negative live totals (structurally impossible — an imbalance
+        counter bump is the violation signal), pins drained, and tracked
+        total within tolerance of the backend's in-use bytes when the
+        backend reports them."""
+        problems: List[str] = []
+        with self._lock:
+            for name, live in self._live.items():
+                if live < 0:
+                    problems.append(f"negative live bytes for {name}: {live}")
+            for name, pins in self._pins.items():
+                if pins != 0:
+                    problems.append(f"undrained pins for {name}: {pins}")
+        rec = self.reconcile()
+        stats = rec["backend"]
+        if stats and stats.get("bytes_in_use") and rec["trackedBytes"]:
+            in_use = int(stats["bytes_in_use"])
+            if rec["trackedBytes"] > in_use * (1.0 + drift_tolerance_fraction):
+                problems.append(
+                    f"tracked {rec['trackedBytes']} B exceeds backend "
+                    f"in-use {in_use} B beyond tolerance")
+        return problems
+
+    def reset(self) -> None:
+        """Drop all accounting (tests / hermeticity).  Keeps configuration."""
+        with self._lock:
+            self._live.clear()
+            self._peak.clear()
+            self._pins.clear()
+            self._events.clear()
+        for g in self._subsys_gauges.values():
+            g.set(0)
+        self._live_gauge.set(0)
+        self._util_gauge.set(0.0)
+        self._drift_gauge.set(0)
+        self.costs.reset()
+
+
+_LEDGER: Optional[DeviceMemoryLedger] = None
+_LEDGER_LOCK = threading.Lock()
+
+
+def memory_ledger() -> DeviceMemoryLedger:
+    """Process-wide ledger singleton (disabled until ``configure``)."""
+    global _LEDGER
+    if _LEDGER is None:
+        with _LEDGER_LOCK:
+            if _LEDGER is None:
+                _LEDGER = DeviceMemoryLedger()
+    return _LEDGER
+
+
+def set_memory_ledger(ledger: Optional[DeviceMemoryLedger]) -> None:
+    """Test seam: swap (or with None, lazily rebuild) the singleton."""
+    global _LEDGER
+    _LEDGER = ledger
+
+
+def cost_ledger() -> ExecutableCostLedger:
+    return memory_ledger().costs
+
+
+def configure(config) -> DeviceMemoryLedger:
+    """Wire ``memory.*`` config keys into the ledger singleton."""
+    ledger = memory_ledger()
+    ledger.configure(
+        enabled=bool(config.get("memory.enabled")),
+        headroom_fraction=float(config.get("memory.headroom.fraction")),
+        budget_bytes=int(config.get("memory.device.budget.bytes")),
+        analysis_mode=str(config.get("memory.analysis.mode")))
+    return ledger

@@ -49,8 +49,12 @@ class SloObjective:
 
 
 def objectives_from_config(config) -> List[SloObjective]:
-    """The three built-in objectives, thresholds from ``slo.*`` keys."""
+    """The four built-in objectives, thresholds from ``slo.*`` keys."""
     return [
+        SloObjective(
+            name="memory-headroom",
+            pattern="Memory.device-utilization",
+            threshold=float(config.get("slo.memory.utilization.max"))),
         SloObjective(
             name="endpoint-latency-p99",
             pattern="KafkaCruiseControlServlet.*-successful-request-execution-timer",

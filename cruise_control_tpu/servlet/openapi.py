@@ -67,9 +67,17 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
                         "the metric registry by the obsvc history thread "
                         "(obs.history.*); the SLO burn-rate evaluator reads "
                         "the same rings", [
-        ("sensor", "string", "fnmatch pattern restricting the sensors"),
+        ("sensor", "string", "fnmatch pattern restricting the sensors "
+         "(glob, e.g. Memory.*)"),
         ("since_ms", "number", "drop samples older than this epoch ms"),
+        ("limit", "integer", "max series returned (default 64, cap 1024); "
+         "truncated=true in the body when matches were dropped"),
     ], "VIEWER"),
+    "memory": ("Device-memory observatory: per-subsystem live-bytes ledger, "
+               "backend reconciliation, headroom-guard shrink/refusal "
+               "counters, and per-executable compile-cost rows "
+               "(flops, bytes-accessed, arg/out/temp bytes, derived peak); "
+               "404 while memory.enabled=false", [], "VIEWER"),
     "compile_cache": ("Compile-service state: shape-bucket policy, compiled "
                       "lane widths, persistent XLA cache, warmup progress, "
                       "per-bucket compile/hit/miss counters", [], "VIEWER"),
@@ -80,8 +88,10 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
                "circuit, accelerator liveness, crash-journal lag) with a "
                "ready/degraded/unhealthy rollup; 503 + Retry-After while "
                "unhealthy", [], "VIEWER"),
-    "profile": ("Capture a JAX device+host profile for duration_s seconds "
-                "and write a TensorBoard trace directory", [
+    "profile": ("Open a JAX device+host profile capture window for "
+                "duration_s seconds on a background thread (202; poll "
+                "GET /profile) writing a TensorBoard trace directory; "
+                "409 while a window is already open", [
         ("duration_s", "number", "capture window seconds (default 2, "
          "max 600)"),
     ], "ADMIN"),
@@ -156,6 +166,17 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
     ], "ADMIN"),
 }
 
+#: Routes served under BOTH verbs: ENDPOINT_INFO describes the POST
+#: operation; this table supplies the GET operation (summary, params,
+#: role, component name, response schema).
+DUAL_GET_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str,
+                               str, Dict]] = {
+    "profile": ("Pollable profile-capture status: busy while a window is "
+                "open, done + trace_dir once the last async capture landed",
+                [], "VIEWER", "ProfileStatusResponse",
+                schemas.PROFILE_STATUS_SCHEMA),
+}
+
 #: Schema components referenced by more than one endpoint get one shared
 #: component name; everything else is named after its endpoint.
 _SHARED = {
@@ -198,7 +219,12 @@ def build_spec() -> Dict:
                                    "AsyncProgress": PROGRESS_SCHEMA}
     paths: Dict[str, Dict] = {}
     for endpoint, (summary, params, role) in sorted(ENDPOINT_INFO.items()):
-        method = "get" if endpoint in GET_ENDPOINTS else "post"
+        # Dual-verb routes: ENDPOINT_INFO is the POST operation, the GET
+        # operation comes from DUAL_GET_INFO below.
+        if endpoint in POST_ENDPOINTS:
+            method = "post"
+        else:
+            method = "get"
         cname = _component_name(endpoint)
         components.setdefault(cname, schemas.ENDPOINT_SCHEMAS[endpoint])
         ref = {"$ref": f"#/components/schemas/{cname}"}
@@ -221,7 +247,18 @@ def build_spec() -> Dict:
                                "returned User-Task-ID header",
                 "content": {"application/json": {"schema":
                             {"$ref": "#/components/schemas/AsyncProgress"}}}}
-        paths[f"{API_PREFIX}/{endpoint}"] = {method: {
+        if endpoint == "memory":
+            responses["404"] = {
+                "description": "memory ledger disabled (memory.enabled="
+                               "false)",
+                "content": {"application/json": {"schema":
+                            {"$ref": "#/components/schemas/Error"}}}}
+        if endpoint == "profile":
+            responses["409"] = {
+                "description": "a capture window is already open",
+                "content": {"application/json": {"schema":
+                            {"$ref": "#/components/schemas/Error"}}}}
+        ops = {method: {
             "operationId": endpoint.replace("/", "_"),
             "summary": summary,
             "description": f"Minimum role: {role}.",
@@ -232,6 +269,26 @@ def build_spec() -> Dict:
             ],
             "responses": responses,
         }}
+        if endpoint in GET_ENDPOINTS and method == "post":
+            gsummary, gparams, grole, gcname, gschema = \
+                DUAL_GET_INFO[endpoint]
+            components.setdefault(gcname, gschema)
+            ops["get"] = {
+                "operationId": f"{endpoint.replace('/', '_')}_status",
+                "summary": gsummary,
+                "description": f"Minimum role: {grole}.",
+                "parameters": [
+                    {"name": n, "in": "query", "required": False,
+                     "description": d, "schema": {"type": t}}
+                    for n, t, d in gparams
+                ],
+                "responses": {"200": {
+                    "description": "success",
+                    "content": {"application/json": {"schema":
+                                {"$ref": f"#/components/schemas/"
+                                         f"{gcname}"}}}}},
+            }
+        paths[f"{API_PREFIX}/{endpoint}"] = ops
     return {
         "openapi": "3.0.3",
         "info": {

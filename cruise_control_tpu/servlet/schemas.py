@@ -303,11 +303,59 @@ TRACE_SCHEMA = {
 
 PROFILE_SCHEMA = {
     "type": "object",
-    "required": ["message", "trace_dir", "duration_s"],
+    "required": ["message", "status", "trace_dir", "duration_s"],
     "properties": {
         "message": {"type": "string"},
+        "status": {"type": "string"},
         "trace_dir": {"type": "string"},
         "duration_s": {"type": "number"},
+    },
+}
+
+# GET /profile — pollable async-capture state.
+PROFILE_STATUS_SCHEMA = {
+    "type": "object",
+    "required": ["busy", "done"],
+    "properties": {
+        "busy": {"type": "boolean"},
+        "done": {"type": "boolean"},
+        "trace_dir": {"type": ["string", "null"]},
+        "duration_s": {"type": "number"},
+        "started_ms": {"type": "integer"},
+        "error": {"type": ["string", "null"]},
+    },
+}
+
+MEMORY_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "analysisMode", "liveBytes", "subsystems",
+                 "guard", "reconcile", "costs"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "analysisMode": {"type": "string"},
+        "headroomFraction": {"type": "number"},
+        "deviceBudgetBytes": {"type": ["integer", "null"]},
+        "liveBytes": {"type": "integer"},
+        # subsystem -> {liveBytes, peakBytes, pins}
+        "subsystems": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "object",
+                "properties": {"liveBytes": {"type": "integer"},
+                               "peakBytes": {"type": "integer"},
+                               "pins": {"type": "integer"}},
+            },
+        },
+        "events": {"type": "object",
+                   "additionalProperties": {"type": "integer"}},
+        "guard": {"type": "object",
+                  "properties": {"shrinks": {"type": "integer"},
+                                 "refusals": {"type": "integer"}}},
+        "reconcile": {"type": "object"},
+        # bucket label -> compile-cost row (flops, bytes_accessed,
+        # arg/out/temp/generated bytes, derived peak_bytes)
+        "costs": {"type": "object",
+                  "additionalProperties": {"type": "object"}},
     },
 }
 
@@ -371,6 +419,8 @@ METRICS_HISTORY_SCHEMA = {
         "intervalMs": {"type": "number"},
         "ringSize": {"type": "integer"},
         "samples": {"type": "integer"},
+        # True when the series cap (limit param) dropped matching rings.
+        "truncated": {"type": "boolean"},
         # sensor name -> [[ts_ms, value], ...] oldest first
         "series": {
             "type": "object",
@@ -435,5 +485,6 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "compile_cache": COMPILE_CACHE_SCHEMA,
     "trace": TRACE_SCHEMA,
     "profile": PROFILE_SCHEMA,
+    "memory": MEMORY_SCHEMA,
     "health": HEALTH_SCHEMA,
 }

@@ -47,7 +47,7 @@ _RANK = {Role.VIEWER: 0, Role.USER: 1, Role.ADMIN: 2}
 # shapes and phase timings).
 _VIEWER_GET = {"kafka_cluster_state", "user_tasks", "review_board", "metrics",
                "compile_cache", "trace", "health", "solver_stats",
-               "metrics/history"}
+               "metrics/history", "memory", "profile"}
 _ADMIN_GET = {"bootstrap", "train"}
 
 

@@ -50,7 +50,7 @@ REQUEST_ID_HEADER = "X-Request-ID"
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
                  "metrics", "compile_cache", "trace", "health",
-                 "solver_stats", "metrics/history"}
+                 "solver_stats", "metrics/history", "memory", "profile"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -248,7 +248,11 @@ class CruiseControlApp:
             self.purgatory.take_approved(int(review_id))
 
         # Slash endpoints (metrics/history) dispatch to underscore methods.
-        handler = getattr(self, f"_ep_{endpoint.replace('/', '_')}", None)
+        # A verb-specific handler (``_ep_get_profile``) wins over the shared
+        # one for routes served under both verbs.
+        ep_name = endpoint.replace("/", "_")
+        handler = (getattr(self, f"_ep_{method.lower()}_{ep_name}", None)
+                   or getattr(self, f"_ep_{ep_name}", None))
         if handler is None:
             return 501, {"error": f"{endpoint} not implemented"}, {}
         # Per-endpoint servlet sensors (Sensors.md: <endpoint>-request-rate,
@@ -347,7 +351,10 @@ class CruiseControlApp:
                      "records": records}, {}
 
     def _ep_metrics_history(self, params, task_id):
-        """Sensor time-series rings sampled by the obsvc history thread."""
+        """Sensor time-series rings sampled by the obsvc history thread.
+        ``sensor`` accepts an exact name or an fnmatch glob (prefix queries
+        like ``Memory.*``); the response is bounded to ``limit`` series
+        (default 64, capped) with a ``truncated`` flag."""
         from cruise_control_tpu.obsvc import history
         hist = history()
         since_raw = params.get("since_ms")
@@ -355,13 +362,21 @@ class CruiseControlApp:
             since_ms = float(since_raw) if since_raw is not None else None
         except ValueError:
             return 400, {"error": "since_ms must be a number"}, {}
-        series = hist.history(pattern=params.get("sensor"), since_ms=since_ms)
+        try:
+            limit = int(params.get("limit", str(hist.DEFAULT_SERIES_LIMIT)))
+        except ValueError:
+            return 400, {"error": "limit must be an integer"}, {}
+        if limit <= 0:
+            return 400, {"error": "limit must be positive"}, {}
+        series, truncated = hist.history_bounded(
+            pattern=params.get("sensor"), since_ms=since_ms, limit=limit)
         from cruise_control_tpu.obsvc.history import SAMPLES_SENSOR
         from cruise_control_tpu.common.metrics import registry
         return 200, {"enabled": hist.running,
                      "intervalMs": hist.interval_s * 1000.0,
                      "ringSize": hist.ring_size,
                      "samples": registry().counter(SAMPLES_SENSOR).count,
+                     "truncated": truncated,
                      "series": series}, {}
 
     def _ep_compile_cache(self, params, task_id):
@@ -434,23 +449,43 @@ class CruiseControlApp:
                      "coefficients": None if coef is None else coef.tolist()}, {}
 
     def _ep_profile(self, params, task_id):
-        """Admin: capture a JAX profiler trace for ``duration_s`` seconds
-        (synchronous — the handler thread sleeps through the window)."""
+        """Admin: open a JAX profiler capture window for ``duration_s``
+        seconds on a background thread and answer 202 immediately — poll
+        ``GET /profile`` for busy/done/trace_dir.  A second POST while a
+        window is open (sync or async) answers 409."""
         from cruise_control_tpu.obsvc import profiler
         try:
             duration_s = float(params.get("duration_s", "2.0"))
         except ValueError:
             return 400, {"error": "duration_s must be a number"}, {}
         try:
-            out = profiler.capture(duration_s)
+            out = profiler.start_async(duration_s)
         except ValueError as e:
             return 400, {"error": str(e)}, {}
         except profiler.ProfileInProgress as e:
             return 409, {"error": str(e)}, {}
         except Exception as e:   # noqa: BLE001 — profiler backend seam
-            LOG.exception("profile capture failed")
+            LOG.exception("profile capture failed to start")
             return 500, {"error": type(e).__name__, "message": str(e)}, {}
-        return 200, {"message": "profile captured", **out}, {}
+        return 202, {"message": "profile capture started",
+                     "status": "started", **out}, {}
+
+    def _ep_get_profile(self, params, task_id):
+        """Pollable capture status: busy while a window is open, done +
+        trace_dir once the last async capture landed."""
+        from cruise_control_tpu.obsvc import profiler
+        return 200, profiler.status(), {}
+
+    def _ep_memory(self, params, task_id):
+        """Device-memory observatory: per-subsystem live-bytes ledger,
+        backend reconciliation, headroom-guard counters, and the
+        per-executable compile-cost rows (404 while memory.enabled=false)."""
+        from cruise_control_tpu.obsvc.memory import memory_ledger
+        ledger = memory_ledger()
+        if not ledger.enabled:
+            return 404, {"error": "memory ledger disabled "
+                                  "(memory.enabled=false)"}, {}
+        return 200, ledger.snapshot(), {}
 
     # ---- async operations (202-until-done)
 

@@ -1,0 +1,198 @@
+"""Benchmark regression gate: diff a bench/profile snapshot against the
+committed baselines with per-metric tolerances.
+
+Usage:
+    python scripts/bench_gate.py                        # self-diff, exits 0
+    python scripts/bench_gate.py --bench NEW.json       # gate a fresh run
+    python scripts/bench_gate.py --profile NEW.json
+    python scripts/bench_gate.py --bench-baseline BENCH_r04.json ...
+
+With no arguments the committed snapshots are compared against themselves
+— a structural smoke (parsers work, every metric extracts, tolerances
+resolve) that always exits 0.  Point ``--bench`` / ``--profile`` at a
+freshly captured artifact to gate it: any "higher is worse" metric (wall
+clock, per-goal ms, peak/temp bytes) exceeding ``baseline * ratio +
+slack`` is a regression; the gate lists them all and exits 1.  Runnable
+in CI and wrapped as a slow test (tests/test_memory.py).
+
+Accepted bench formats: the committed driver wrapper ``{n, cmd, rc,
+tail}`` whose ``tail`` holds JSON-lines rows (the first line may be
+truncated mid-object — tolerated), a plain JSON list of rows, or a
+.jsonl file.  Duplicate metrics keep the LATEST row, matching how the
+driver tail overwrites earlier runs.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BENCH_BASELINE = os.path.join(REPO, "BENCH_r05.json")
+DEFAULT_PROFILE_BASELINE = os.path.join(REPO, "profile_r05.json")
+
+# (check-name glob, ratio, absolute slack) — first match wins.  Ratios sit
+# well under 2 so an injected 2x regression always trips; the absolute
+# slack keeps sub-hundredth-of-a-second metrics from flapping on noise.
+TOLERANCES: List[Tuple[str, float, float]] = [
+    ("bench:*:peak_bytes", 1.25, float(1 << 20)),
+    ("bench:*:temp_bytes", 1.25, float(1 << 20)),
+    ("bench:*:value", 1.5, 0.05),            # seconds
+    ("profile:*:total_s", 1.5, 0.5),
+    ("profile:*:ms", 1.6, 50.0),
+    ("profile:*:peak_bytes", 1.25, float(1 << 20)),
+    ("*", 1.5, 0.0),
+]
+
+
+def tolerance_for(name: str) -> Tuple[float, float]:
+    for pattern, ratio, slack in TOLERANCES:
+        if fnmatch.fnmatch(name, pattern):
+            return ratio, slack
+    return 1.5, 0.0
+
+
+# ---------------------------------------------------------------- parsing
+
+def _bench_rows(doc) -> List[dict]:
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    if isinstance(doc, dict) and "tail" in doc:
+        rows = []
+        for line in str(doc["tail"]).splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue        # tail's first line is often cut mid-object
+            if isinstance(row, dict) and "metric" in row:
+                rows.append(row)
+        return rows
+    raise ValueError("unrecognized bench snapshot format")
+
+
+def load_bench(path: str) -> Dict[str, float]:
+    """Flatten a bench snapshot to ``bench:<metric>:<col> -> value`` for
+    every higher-is-worse numeric column.  Duplicate metrics: latest wins
+    (rows are ordered; dict assignment overwrites)."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        # .jsonl: one row per line
+        rows = _bench_rows({"tail": raw})
+    else:
+        rows = _bench_rows(doc)     # unrecognized JSON shape: ValueError
+    out: Dict[str, float] = {}
+    for row in rows:
+        metric = row.get("metric")
+        if not metric:
+            continue
+        for col in ("value", "value_per_lane", "peak_bytes", "temp_bytes"):
+            v = row.get(col)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"bench:{metric}:{col}"] = float(v)
+    return out
+
+
+def load_profile(path: str) -> Dict[str, float]:
+    """Flatten a profile artifact to ``profile:<pass>[:<goal>]:<col>``."""
+    with open(path) as f:
+        doc = json.load(f)
+    out: Dict[str, float] = {}
+    for pass_name, p in (doc.get("passes") or {}).items():
+        if isinstance(p.get("total_s"), (int, float)):
+            out[f"profile:{pass_name}:total_s"] = float(p["total_s"])
+        for g in p.get("goals") or []:
+            goal = g.get("goal", "?")
+            for col in ("ms", "peak_bytes"):
+                v = g.get(col)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"profile:{pass_name}:{goal}:{col}"] = float(v)
+    return out
+
+
+# ---------------------------------------------------------------- compare
+
+def compare(baseline: Dict[str, float],
+            current: Dict[str, float]) -> Tuple[int, List[str]]:
+    """(metrics compared, regression descriptions).  Only metrics present
+    on BOTH sides are gated — new columns (e.g. peak_bytes against an
+    older baseline) pass by default, removed ones are reported too."""
+    regressions: List[str] = []
+    shared = sorted(set(baseline) & set(current))
+    for name in shared:
+        base, cur = baseline[name], current[name]
+        ratio, slack = tolerance_for(name)
+        limit = base * ratio + slack
+        if cur > limit:
+            regressions.append(
+                f"{name}: {cur:g} > limit {limit:g} "
+                f"(baseline {base:g}, x{ratio:g} + {slack:g})")
+    return len(shared), regressions
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv)
+
+    def opt(flag: str, default: str) -> str:
+        if flag in args:
+            i = args.index(flag)
+            value = args[i + 1]
+            del args[i:i + 2]
+            return value
+        return default
+
+    bench_baseline = opt("--bench-baseline", DEFAULT_BENCH_BASELINE)
+    profile_baseline = opt("--profile-baseline", DEFAULT_PROFILE_BASELINE)
+    bench_current = opt("--bench", bench_baseline)
+    profile_current = opt("--profile", profile_baseline)
+    if args:
+        print(f"bench_gate: unknown arguments {args}", file=sys.stderr)
+        return 2
+
+    compared = 0
+    regressions: List[str] = []
+    for label, loader, base_path, cur_path in (
+            ("bench", load_bench, bench_baseline, bench_current),
+            ("profile", load_profile, profile_baseline, profile_current)):
+        if not (os.path.exists(base_path) and os.path.exists(cur_path)):
+            print(f"bench_gate: {label}: snapshot missing "
+                  f"({base_path} / {cur_path}) — skipped")
+            continue
+        try:
+            base = loader(base_path)
+            cur = loader(cur_path)
+        except (ValueError, OSError, KeyError) as e:
+            print(f"bench_gate: {label}: unreadable snapshot: {e}",
+                  file=sys.stderr)
+            return 2
+        n, regs = compare(base, cur)
+        print(f"bench_gate: {label}: {n} metrics compared "
+              f"({os.path.basename(cur_path)} vs "
+              f"{os.path.basename(base_path)}), {len(regs)} regressions")
+        compared += n
+        regressions.extend(regs)
+
+    if compared == 0:
+        print("bench_gate: nothing compared (no snapshots found)",
+              file=sys.stderr)
+        return 2
+    if regressions:
+        print(f"bench_gate: FAIL — {len(regressions)} regression(s):",
+              file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"bench_gate: OK — {compared} metrics within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -140,6 +140,12 @@ def collect_live(timeout_s: float = 90.0):
                                          headers={"User-Task-ID": task_id})
             if status != 200:
                 raise RuntimeError(f"/proposals did not complete: {status}")
+        # memory.enabled defaults True, so the boot activated the device
+        # ledger; exercise the endpoint so Memory.* gauges reflect a live
+        # drive, not just eager materialization.
+        status, _, _ = get("/memory")
+        if status != 200:
+            raise RuntimeError(f"/memory not serving: {status}")
         _, body, _ = get("/metrics?json=true")
         _, text, _ = get("/metrics")
         return json.loads(body)["sensors"], text
@@ -147,10 +153,14 @@ def collect_live(timeout_s: float = 90.0):
         app.stop()
         app.cc.shutdown()
         # Hermeticity for in-suite callers: build_app enabled the process
-        # tracer; later test modules expect the default-off state.
+        # tracer and memory ledger; later test modules expect the
+        # default-off state.
         from cruise_control_tpu.obsvc.tracer import tracer
         tracer().configure(enabled=False, ring_size=32)
         tracer().reset()
+        from cruise_control_tpu.obsvc.memory import memory_ledger
+        memory_ledger().reset()
+        memory_ledger().configure(enabled=False)
 
 
 def main() -> int:

@@ -5,8 +5,9 @@ Usage: python scripts/profile_solve.py [cpu|tpu] [small|big] [--json PATH]
 Mirrors GoalOptimizer.optimizations goal-by-goal with explicit per-goal
 timing (block_until_ready between goals), after a full warmup pass.
 ``--json PATH`` additionally writes the machine-readable artifact
-(per-goal warmup/steady ms, rounds, moves, violations; the committed
-profile_r{N}.json files are produced this way).
+(per-goal warmup/steady ms, rounds, moves, violations, plus per-bucket
+executable cost columns from the memory observatory's full-analysis
+ledger; the committed profile_r{N}.json files are produced this way).
 """
 
 from __future__ import annotations
@@ -60,6 +61,12 @@ def main() -> None:
             num_replicas=50_000, mean_cpu=0.006, mean_disk=90.0,
             mean_nw_in=90.0, mean_nw_out=90.0, seed=3140)
     state, placement, meta = rc.generate(props)
+    # Memory observatory in FULL analysis mode: cost rows (flops /
+    # bytes-accessed / peak) per executable bucket.  The AOT recompile is
+    # deferred to finalize_full(), paid once after the warmup pass so the
+    # steady-state timings stay untouched.
+    from cruise_control_tpu.obsvc.memory import cost_ledger, memory_ledger
+    memory_ledger().configure(enabled=True, analysis_mode="full")
     optimizer = GoalOptimizer(goal_names=GOALS)
     goals = get_goals_by_priority(GOALS)
     gctx = build_context(state, placement, meta, optimizer.constraint,
@@ -75,6 +82,7 @@ def main() -> None:
         rows = []
         agg = None
         for goal in goals:
+            labels_before = set(cost_ledger().rows())
             t0 = time.monotonic()
             pl, agg, info = solver.optimize_goal(goal, priors, gctx, pl, agg)
             jax.block_until_ready(pl.broker)
@@ -88,12 +96,33 @@ def main() -> None:
                          "ms_per_round": round(dt * 1000 / max(info.rounds, 1), 1),
                          "moves": info.moves_applied,
                          "violated_before": info.violated_brokers_before,
-                         "violated_after": info.violated_brokers_after})
+                         "violated_after": info.violated_brokers_after,
+                         # Buckets whose first compile landed in this goal's
+                         # window — cost columns attach after finalize_full.
+                         "cost_labels": sorted(
+                             set(cost_ledger().rows()) - labels_before)})
             priors.append(goal)
         total = time.monotonic() - total0
         print(f"{label} total={total:.3f}s")
         artifact["passes"][label] = {"total_s": round(total, 3), "goals": rows}
         return pl
+
+    def attach_costs():
+        """Finalize deferred full-mode analysis, then fill per-goal cost
+        columns (sum of flops/bytes-accessed, max peak over the buckets the
+        goal compiled) and the top-level per-bucket costs table."""
+        cost_ledger().finalize_full()
+        all_rows = cost_ledger().rows()
+        artifact["costs"] = all_rows
+        for p in artifact["passes"].values():
+            for g in p["goals"]:
+                labels = g.pop("cost_labels", [])
+                crows = [all_rows[l] for l in labels if l in all_rows]
+                g["flops"] = sum(r.get("flops") or 0.0 for r in crows)
+                g["bytes_accessed"] = sum(
+                    r.get("bytes_accessed") or 0.0 for r in crows)
+                g["peak_bytes"] = max(
+                    (r.get("peak_bytes") or 0 for r in crows), default=0)
 
     print(f"backend={backend} size={size}")
     # cache_warm only says the cache DIR holds entries (possibly for a
@@ -103,6 +132,9 @@ def main() -> None:
     one_pass("warmup", placement)
     print("steady-state:")
     one_pass("steady", placement)
+    attach_costs()
+    print(f"cost rows: {len(artifact['costs'])} buckets "
+          f"(max peak_bytes={max((r.get('peak_bytes') or 0 for r in artifact['costs'].values()), default=0)})")
     if json_path:
         import json
         with open(json_path, "w") as f:

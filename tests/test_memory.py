@@ -1,0 +1,556 @@
+"""Device-memory & executable-cost observatory tests (docs/MEMORY.md):
+ledger post/reconcile accounting, resident pin/donation bookkeeping, the
+compile-cost ledger in lowered/full modes, the dispatch headroom guard
+(shrink + refusal tagging), the /memory endpoint, ledger-on/off cache-key
+identity, and the bench_gate regression gate."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.obsvc.memory import (
+    SUBSYS_LANES,
+    SUBSYS_RESIDENT,
+    DeviceMemoryLedger,
+    ExecutableCostLedger,
+    measure_bytes,
+    memory_ledger,
+    set_memory_ledger,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def ledger():
+    """A scenario-private enabled ledger swapped into the singleton seam,
+    restored afterwards (counters are process-registry sensors — tests diff
+    them, they never assume zero)."""
+    prev = memory_ledger()
+    led = DeviceMemoryLedger()
+    led.configure(enabled=True, analysis_mode="off")
+    set_memory_ledger(led)
+    yield led
+    set_memory_ledger(prev)
+
+
+# ---------------------------------------------------------------- ledger
+
+
+def test_measure_bytes_counts_array_leaves():
+    tree = {"a": np.zeros((4, 8), np.float32), "b": [np.zeros(3, np.int32)],
+            "c": "not-an-array", "d": None}
+    assert measure_bytes(tree) == 4 * 8 * 4 + 3 * 4
+    assert measure_bytes(None) == 0
+    assert measure_bytes({}) == 0
+
+
+def test_ledger_post_balance_clamp_and_events(ledger):
+    imb0 = ledger.imbalance_count
+    ledger.post(SUBSYS_LANES, 1000, kind="alloc")
+    ledger.post(SUBSYS_RESIDENT, 500, kind="alloc")
+    assert ledger.live_bytes() == 1500
+    assert ledger.live_bytes(SUBSYS_LANES) == 1000
+    ledger.post(SUBSYS_LANES, 1000, kind="free")
+    assert ledger.live_bytes(SUBSYS_LANES) == 0
+    # Donation: counted, never summed.
+    ledger.post(SUBSYS_RESIDENT, 500, kind="donate")
+    assert ledger.live_bytes(SUBSYS_RESIDENT) == 500
+    # Pin/release refcounts.
+    ledger.post(SUBSYS_RESIDENT, 0, kind="pin")
+    assert ledger.pins(SUBSYS_RESIDENT) == 1
+    ledger.post(SUBSYS_RESIDENT, 0, kind="release")
+    assert ledger.pins(SUBSYS_RESIDENT) == 0
+    assert ledger.imbalance_count == imb0
+    # Over-free clamps at zero and bumps the imbalance counter instead of
+    # going negative; a release without a pin does the same.
+    ledger.post(SUBSYS_RESIDENT, 10_000, kind="free")
+    assert ledger.live_bytes(SUBSYS_RESIDENT) == 0
+    ledger.post(SUBSYS_RESIDENT, 0, kind="release")
+    assert ledger.imbalance_count == imb0 + 2
+    ev = ledger.events()
+    assert ev["alloc"] == 2 and ev["free"] == 2 and ev["donate"] == 1
+    snap = ledger.snapshot()
+    assert snap["enabled"] is True
+    assert snap["liveBytes"] == 0
+    assert snap["subsystems"][SUBSYS_RESIDENT]["peakBytes"] >= 500
+    json.dumps(snap)                          # endpoint body is serializable
+
+
+def test_ledger_disabled_is_noop():
+    led = DeviceMemoryLedger()                # module default: disabled
+    assert led.enabled is False
+    led.post(SUBSYS_LANES, 1000, kind="alloc")
+    assert led.live_bytes() == 0
+    assert led.events() == {}
+    plan, refused = led.guard_lane_plan([], 0, "R64-C64", (1, 2, 4))
+    assert plan == [] and refused is False
+
+
+def test_verify_balanced_flags_undrained_state(ledger):
+    assert ledger.verify_balanced() == []
+    ledger.post(SUBSYS_RESIDENT, 0, kind="pin")
+    problems = ledger.verify_balanced()
+    assert any("pin" in p for p in problems)
+    ledger.post(SUBSYS_RESIDENT, 0, kind="release")
+    assert ledger.verify_balanced() == []
+
+
+def test_reconcile_without_backend_stats_is_none_drift(ledger):
+    rec = ledger.reconcile()
+    assert rec["trackedBytes"] == 0
+    # XLA:CPU exposes no memory_stats; driftBytes is None, not 0-as-fact.
+    if rec["backend"] is None:
+        assert rec["driftBytes"] is None
+
+
+# ------------------------------------------------------------ cost ledger
+
+
+def _jit_add():
+    import jax
+
+    @jax.jit
+    def add(a, b):
+        return a + b
+
+    return add
+
+
+def test_cost_ledger_lowered_mode_rows_and_dispatch_cache_untouched():
+    import jax
+
+    costs = ExecutableCostLedger()
+    add = _jit_add()
+    a = np.zeros((8, 4), np.float32)
+    out = add(a, a)
+    jax.block_until_ready(out)
+    cache0 = add._cache_size()
+    costs.observe_compile("R8-C4", add, (a, a), {}, mode="lowered")
+    row = costs.row("R8-C4")
+    assert row is not None and row["mode"] == "lowered"
+    assert row["count"] == 1
+    assert row["flops"] > 0
+    assert row["bytes_accessed"] > 0
+    assert row["arg_bytes"] == 2 * a.nbytes
+    assert row["out_bytes"] == a.nbytes
+    assert row["peak_bytes"] == row["arg_bytes"] + row["out_bytes"]
+    # The analysis re-lowers on abstract avals: jit's dispatch cache must
+    # hold exactly what it held before (bitwise-identical executables).
+    assert add._cache_size() == cache0
+    # A repeat observation of the same label only bumps the count.
+    costs.observe_compile("R8-C4", add, (a, a), {}, mode="lowered")
+    assert costs.row("R8-C4")["count"] == 2
+    json.dumps(costs.rows())
+
+
+def test_cost_ledger_full_mode_defers_compile_to_finalize():
+    costs = ExecutableCostLedger()
+    add = _jit_add()
+    a = np.zeros((16,), np.float32)
+    costs.observe_compile("R16-C1", add, (a, a), {}, mode="full")
+    row = costs.row("R16-C1")
+    assert row["pending"] is True
+    assert row["temp_bytes"] is None
+    assert "_lowered" not in row              # private stash never exposed
+    json.dumps(costs.rows())
+    assert costs.finalize_full() == 1
+    row = costs.row("R16-C1")
+    assert row["pending"] is False
+    assert row["temp_bytes"] is not None
+    assert row["generated_code_bytes"] is not None
+    assert row["peak_bytes"] >= row["arg_bytes"] + row["out_bytes"]
+    assert costs.finalize_full() == 0         # nothing left pending
+    m = costs.maxima()
+    assert m["peak_bytes"] == row["peak_bytes"]
+
+
+def test_cost_ledger_analysis_failure_is_swallowed():
+    costs = ExecutableCostLedger()
+    costs.observe_compile("bad", object(), (), {}, mode="lowered")
+    assert costs.row("bad") is None           # no row, no exception
+
+
+def test_peak_for_lanes_exact_and_rescaled():
+    costs = ExecutableCostLedger()
+    costs.ingest("R64-C64-L4", {"peak_bytes": 400})
+    assert costs.peak_for_lanes("R64-C64", 4) == 400
+    # No exact row: linear rescale from the nearest recorded width.
+    assert costs.peak_for_lanes("R64-C64", 8) == 800
+    assert costs.peak_for_lanes("R64-C64", 2) == 200
+    # No family data at all: no projection, guard has no basis.
+    assert costs.peak_for_lanes("R128-C64", 8) is None
+
+
+# --------------------------------------------------------- headroom guard
+
+
+def test_guard_shrinks_then_refuses(ledger):
+    from cruise_control_tpu.compilesvc.chunking import plan_lane_chunks
+
+    ladder = (1, 2, 4, 8)
+    ledger.configure(enabled=True, headroom_fraction=0.5, budget_bytes=1000,
+                     analysis_mode="off")       # limit = 500 bytes
+    ledger.costs.ingest("R64-C64-L1", {"peak_bytes": 200})
+    plan = plan_lane_chunks(8, ladder)          # one 8-wide chunk
+    # Width 8 projects 1600 > 500; width 2 projects 400 <= 500 — shrink.
+    shrunk, refused = ledger.guard_lane_plan(plan, 8, "R64-C64", ladder)
+    assert refused is False
+    assert max(c.size for c in shrunk) == 2
+    assert sum(c.n_real for c in shrunk) == 8
+    # Even width 1 (200 bytes) over a 100-byte limit: refuse outright.
+    ledger.configure(enabled=True, headroom_fraction=0.1, budget_bytes=1000,
+                     analysis_mode="off")
+    _, refused = ledger.guard_lane_plan(plan, 8, "R64-C64", ladder)
+    assert refused is True
+    # No recorded projection for the family: pass through untouched.
+    out, refused = ledger.guard_lane_plan(plan, 8, "R999-C64", ladder)
+    assert out is plan and refused is False
+
+
+def test_batch_refusal_degrades_without_crash(ledger):
+    """A refused what-if dispatch returns a degraded-tagged result — seed
+    placements, stranded -1, memory_refused — never an allocator crash."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(num_brokers=8, num_racks=4, num_topics=12,
+                                 num_replicas=256, seed=11)
+    state, placement, meta = rc.generate(props)
+    r_pad = state.num_replicas_padded
+    c = min(64, r_pad)
+    ledger.configure(enabled=True, headroom_fraction=0.5, budget_bytes=1000,
+                     analysis_mode="off")
+    # Every lane width of this family projects far over the 500-byte limit.
+    ledger.costs.ingest(f"R{r_pad}-C{c}-L1", {"peak_bytes": 10 ** 9})
+    opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"])
+    res = opt.batch_remove_scenarios(state, placement, meta,
+                                     [[0], [1], [2]], num_candidates=64)
+    assert res.memory_refused is True
+    assert res.preempted is True
+    assert res.goal_names == []
+    assert (np.asarray(res.stranded_after) == -1).all()
+    assert res.num_scenarios == 3
+    # Lanes carry the untouched seed placement.
+    for s in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(res.placement_for(s).broker),
+            np.asarray(placement.broker))
+    snap = ledger.snapshot()
+    assert snap["guard"]["refusals"] >= 1
+
+
+# ------------------------------------------------- resident-model posting
+
+
+def test_resident_lifecycle_posts_balance(ledger):
+    """Pinned freeze allocs, delta-apply donates (net zero), invalidate
+    frees back to zero — the fuzz invariant's accounting, unit-sized."""
+    from cruise_control_tpu.model.builder import builder_from_snapshot
+    from cruise_control_tpu.model.resident import ResidentModelService
+    from cruise_control_tpu.testing import random_cluster as rc
+
+    props = rc.ClusterProperties(num_brokers=6, num_racks=3, num_topics=8,
+                                 num_replicas=96, seed=7)
+    state, placement, meta = rc.generate(props, pad_replicas_to=128,
+                                         pad_brokers_to=8)
+    imb0 = ledger.imbalance_count
+    svc = ResidentModelService(enabled=True)
+    cm = builder_from_snapshot(state, placement, meta)
+    svc.snapshot(cm, lambda r, b: (128, 8), pin=True)
+    frozen = ledger.live_bytes(SUBSYS_RESIDENT)
+    assert frozen > 0
+    assert ledger.pins(SUBSYS_RESIDENT) == 1
+    svc.release()
+    assert ledger.pins(SUBSYS_RESIDENT) == 0
+    # Journalled edit → delta (donation) path: bytes must not move.
+    (t, p), _ = next(iter(cm.partitions().items()))
+    rs = cm.partition(t, p)
+    cm.set_replica_load(t, p, rs[0].broker_id,
+                        np.full(4, 7.0, dtype=np.float64))
+    svc.snapshot(cm, lambda r, b: (128, 8))
+    assert ledger.live_bytes(SUBSYS_RESIDENT) == frozen
+    assert ledger.events().get("donate", 0) >= 1
+    svc.invalidate("test_resident_lifecycle_posts_balance")
+    assert ledger.live_bytes() == 0
+    ev = ledger.events()
+    assert ev["alloc"] == ev["free"]
+    assert ev["pin"] == ev["release"]
+    assert ledger.imbalance_count == imb0
+    assert ledger.verify_balanced() == []
+
+
+# --------------------------------------- cache-key identity (ledger on/off)
+
+
+def test_ledger_on_off_cache_keys_identical():
+    """Acceptance: the ledger is strictly host-side — a build with
+    memory.enabled=true compiles exactly the executables (same jit cache
+    keys) as a ledger-free build, and observing compiles adds no dispatch
+    cache entries (PR-9 style assertion)."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import solver as solver_mod
+    from cruise_control_tpu.testing import deterministic as det
+
+    state, placement, meta = det.unbalanced().freeze(pad_replicas_to=64,
+                                                     pad_brokers_to=8)
+
+    def run(enabled):
+        prev = memory_ledger()
+        led = DeviceMemoryLedger()
+        led.configure(enabled=enabled, analysis_mode="lowered")
+        set_memory_ledger(led)
+        try:
+            opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"],
+                                solver=solver_mod.GoalSolver())
+            opt.optimizations(state, placement, meta)
+            keys = {k for k in opt.solver._round_cache
+                    if isinstance(k, tuple) and k and k[0] == "solve"}
+            return keys, led
+        finally:
+            set_memory_ledger(prev)
+
+    keys_off, led_off = run(False)
+    keys_on, led_on = run(True)
+    assert keys_off == keys_on
+    assert led_off.costs.rows() == {}          # disabled: no analysis at all
+    rows = led_on.costs.rows()                 # enabled: rows observed,
+    assert rows                                # keyed by bucket labels
+    assert all(label.startswith("R64-") for label in rows)
+
+
+# ----------------------------------------------------------------- /memory
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _boot(extra_cfg):
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig)
+    from cruise_control_tpu.main import build_app
+
+    cfg = CruiseControlConfig({"metric.sampling.interval.ms": 300,
+                               "partition.metrics.window.ms": 600,
+                               **extra_cfg})
+    app = build_app(cfg, port=0)
+    app.cc.start_up()
+    app.start()
+    return app
+
+
+def _shutdown(app):
+    app.stop()
+    app.cc.shutdown()
+    memory_ledger().reset()
+    memory_ledger().configure(enabled=False)
+
+
+def test_memory_endpoint_end_to_end():
+    """GET /memory serves the ledger snapshot on a default boot
+    (memory.enabled=true), memoryState rides /state, and Memory.* rings are
+    queryable through the glob + limit parameters of /metrics/history."""
+    app = _boot({"obs.history.interval.ms": 200})
+    try:
+        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
+        status, body = _get(base, "/memory")
+        assert status == 200, body
+        snap = json.loads(body)
+        assert snap["enabled"] is True
+        assert snap["analysisMode"] == "lowered"
+        assert SUBSYS_RESIDENT in snap["subsystems"]
+        assert isinstance(snap["costs"], dict)
+        assert "driftBytes" in snap["reconcile"]
+
+        status, body = _get(base, "/state")
+        assert status == 200
+        mem_state = json.loads(body)["AnalyzerState"]["memoryState"]
+        assert mem_state["enabled"] is True
+        assert "costs" not in mem_state
+        assert "costRows" in mem_state
+
+        # Memory.* gauges ride the history rings once the sampler ticks.
+        deadline = time.time() + 15
+        hist = {}
+        while time.time() < deadline:
+            _, body = _get(base, "/metrics/history?sensor=Memory.*")
+            hist = json.loads(body)
+            if hist.get("series"):
+                break
+            time.sleep(0.3)
+        assert any(k.startswith("Memory.") for k in hist["series"]), hist
+        assert hist["truncated"] is False
+
+        # limit bounds the series count and flags the truncation.
+        _, body = _get(base, "/metrics/history?limit=1")
+        bounded = json.loads(body)
+        assert len(bounded["series"]) <= 1
+        assert bounded["truncated"] is True
+        status, _ = _get(base, "/metrics/history?limit=nope")
+        assert status == 400
+        status, _ = _get(base, "/metrics/history?limit=0")
+        assert status == 400
+    finally:
+        _shutdown(app)
+
+
+def test_memory_endpoint_404_when_disabled():
+    app = _boot({"memory.enabled": False})
+    try:
+        base = f"http://127.0.0.1:{app.port}/kafkacruisecontrol"
+        status, body = _get(base, "/memory")
+        assert status == 404
+        assert "memory.enabled" in json.loads(body)["error"]
+        # The rest of the surface is unaffected.
+        status, _ = _get(base, "/state")
+        assert status == 200
+    finally:
+        _shutdown(app)
+
+
+# -------------------------------------------------------------- bench gate
+
+
+def _bench_gate_module():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(_REPO, "scripts", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wrapper_doc(rows, truncate_first=False):
+    lines = [json.dumps(r) for r in rows]
+    if truncate_first and lines:
+        lines[0] = lines[0][len(lines[0]) // 2:]   # cut mid-object
+    return {"n": 5, "cmd": "python bench.py", "rc": 0,
+            "tail": "\n".join(lines)}
+
+
+_ROWS = [
+    {"metric": "solve_small", "value": 0.5, "unit": "seconds",
+     "peak_bytes": 1 << 30},
+    {"metric": "solve_big", "value": 8.0, "unit": "seconds",
+     "peak_bytes": 4 << 30, "temp_bytes": 1 << 30},
+]
+
+
+def test_bench_gate_parses_wrapper_and_truncated_tail(tmp_path):
+    gate = _bench_gate_module()
+    path = tmp_path / "bench.json"
+    path.write_text(json.dumps(_wrapper_doc(_ROWS, truncate_first=True)))
+    metrics = gate.load_bench(str(path))
+    # The cut first row is skipped, the intact one extracts fully.
+    assert "bench:solve_small:value" not in metrics
+    assert metrics["bench:solve_big:value"] == 8.0
+    assert metrics["bench:solve_big:peak_bytes"] == float(4 << 30)
+    # Duplicate metrics: the LATEST row wins.
+    dup = _ROWS + [{"metric": "solve_big", "value": 9.5, "unit": "seconds"}]
+    path.write_text(json.dumps(_wrapper_doc(dup)))
+    assert gate.load_bench(str(path))["bench:solve_big:value"] == 9.5
+    # A plain JSON list of rows parses too.
+    path.write_text(json.dumps(_ROWS))
+    assert gate.load_bench(str(path))["bench:solve_small:value"] == 0.5
+
+
+def test_bench_gate_pass_and_injected_regression(tmp_path):
+    gate = _bench_gate_module()
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps(_wrapper_doc(_ROWS)))
+    profile = tmp_path / "profile.json"
+    profile.write_text(json.dumps({"backend": "cpu", "size": "small",
+                                   "passes": {"steady": {
+                                       "total_s": 10.0,
+                                       "goals": [{"goal": "G", "ms": 800.0,
+                                                  "rounds": 3}]}}}))
+    args = ["--bench-baseline", str(baseline),
+            "--profile-baseline", str(profile)]
+    # Self-diff: identical snapshots pass.
+    assert gate.main(args) == 0
+    # Injected 2x regression on a big metric fails the gate.
+    bad_rows = [dict(r) for r in _ROWS]
+    bad_rows[1]["value"] *= 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_wrapper_doc(bad_rows)))
+    assert gate.main(args + ["--bench", str(bad)]) == 1
+    # A 2x profile regression (per-goal ms and total_s) fails too.
+    bad_profile = tmp_path / "bad_profile.json"
+    bad_profile.write_text(json.dumps({"backend": "cpu", "size": "small",
+                                       "passes": {"steady": {
+                                           "total_s": 20.0,
+                                           "goals": [{"goal": "G",
+                                                      "ms": 1600.0,
+                                                      "rounds": 3}]}}}))
+    assert gate.main(args + ["--profile", str(bad_profile)]) == 1
+    # New columns absent from the baseline (peak_bytes against an old
+    # snapshot) are not gated — only shared metrics compare.
+    old_rows = [{k: v for k, v in r.items()
+                 if k not in ("peak_bytes", "temp_bytes")} for r in _ROWS]
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_wrapper_doc(old_rows)))
+    assert gate.main(["--bench-baseline", str(old), "--bench", str(baseline),
+                      "--profile-baseline", str(profile)]) == 0
+    # Unreadable snapshot: distinct exit code, not a crash or a pass.
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert gate.main(args + ["--bench", str(empty)]) == 2
+
+
+@pytest.mark.slow
+def test_bench_gate_committed_snapshots_self_diff():
+    """CI wiring: the gate run with no arguments diffs the committed r05
+    snapshots against themselves and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "bench_gate.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+# --------------------------------------------------- history sibling rings
+
+
+def test_history_timer_sibling_rings_and_bounded(monkeypatch):
+    import importlib
+
+    from cruise_control_tpu.common.metrics import MetricRegistry
+    from cruise_control_tpu.obsvc.history import HistoryRecorder
+
+    history_mod = importlib.import_module("cruise_control_tpu.obsvc.history")
+    reg = MetricRegistry()
+    monkeypatch.setattr(history_mod, "registry", lambda: reg)
+    t = reg.timer("MemTest.timer")
+    for ms in (10.0, 20.0, 90.0):
+        t.update_ms(ms)
+    rec = HistoryRecorder(interval_s=3600.0, ring_size=8,
+                          clock=lambda: 1000.0)
+    rec.sample_once()
+    # The bare ring stays p99 (SLO windows read it unchanged); the sibling
+    # rings carry p50/max under dotted names.
+    stats = t.stats()
+    assert rec.series("MemTest.timer")[-1][1] == stats["p99_ms"]
+    assert rec.series("MemTest.timer.p50_ms")[-1][1] == stats["p50_ms"]
+    assert rec.series("MemTest.timer.max_ms")[-1][1] == stats["max_ms"]
+    # Sibling rings are plain 2-tuple rings, SLO-burn compatible.
+    for name in ("MemTest.timer.p50_ms", "MemTest.timer.max_ms"):
+        for point in rec.series(name):
+            assert len(point) == 2
+    # history_bounded: name-sorted cap + truncation flag.
+    out, truncated = rec.history_bounded(pattern="MemTest.*", limit=2)
+    assert truncated is True and len(out) == 2
+    assert list(out) == sorted(out)
+    out, truncated = rec.history_bounded(pattern="MemTest.*", limit=50)
+    assert truncated is False and len(out) == 3

@@ -452,11 +452,27 @@ def test_trace_and_profile_endpoints_end_to_end(tmp_path):
         assert _find(root, "optimize")
         assert trace["rollup"]["http.rebalance"]["count"] >= 1
 
-        status, body, _ = _post(base, "/profile?duration_s=0.2")
-        assert status == 200, body
+        # Async capture: 202 immediately, GET /profile polls to done, the
+        # trace dir materializes by the time done flips.
+        status, body, _ = _post(base, "/profile?duration_s=0.4")
+        assert status == 202, body
         out = json.loads(body)
-        assert os.path.isdir(out["trace_dir"])
+        assert out["status"] == "started"
         assert out["trace_dir"].startswith(str(tmp_path))
+        # 409 while the window is open (the second POST races the 0.4 s
+        # window — tolerate it landing after close on a slow machine).
+        status, body, _ = _post(base, "/profile?duration_s=0.1")
+        assert status in (202, 409), body
+        poll_deadline = time.time() + 30
+        while time.time() < poll_deadline:
+            _, sbody, _ = _get(base, "/profile")
+            st = json.loads(sbody)
+            if st["done"] and not st["busy"]:
+                break
+            time.sleep(0.1)
+        assert st["done"] and not st["busy"], st
+        assert st["error"] is None
+        assert os.path.isdir(st["trace_dir"])
 
         status, body, _ = _post(base, "/profile?duration_s=nope")
         assert status == 400

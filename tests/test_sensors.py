@@ -134,7 +134,8 @@ def _assert_exposition_valid(text):
     """Strict line-format check of the Prometheus text exposition: every
     line is a well-formed TYPE declaration or a sample; TYPE precedes its
     family's samples; no duplicate TYPE or sample series; every value
-    parses as a float."""
+    parses as a float; every summary family exports its full quantile
+    spread (count, p50/p99/max)."""
     typed = {}
     samples = set()
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -163,6 +164,11 @@ def _assert_exposition_valid(text):
             assert any(name == base or name.startswith(base + "_")
                        for base in typed), \
                 f"line {lineno}: sample {name} precedes its TYPE line"
+    for base, mtype in typed.items():
+        if mtype == "summary":
+            for stat in ("count", "p50_ms", "p99_ms", "max_ms"):
+                assert f"{base}_{stat}" in samples, \
+                    f"summary {base} missing {stat} sample"
     assert typed and samples
 
 
@@ -171,7 +177,9 @@ def test_exposition_checker_catches_junk():
     for bad in ("# TYPE x counter\nx 1\nx 2\n",          # duplicate series
                 "x 1\n",                                  # sample before TYPE
                 "# TYPE x counter\nx one\n",              # non-float value
-                "# TYPE x counter\n# TYPE x gauge\nx 1\n"):   # dup TYPE
+                "# TYPE x counter\n# TYPE x gauge\nx 1\n",    # dup TYPE
+                # summary missing its quantile spread (no p50/max)
+                "# TYPE t summary\nt_count 1\nt_p99_ms 2.0\n"):
         with pytest.raises(AssertionError):
             _assert_exposition_valid(bad)
 
