@@ -51,6 +51,12 @@ one JSON line each (headline LAST):
   minus greedy balancedness; ≥ 0 means the fractional solve lost
   nothing).  The warm-lane rung is ISSUE 15's acceptance comparison
   against the r05 4.73 s/lane warm what-if row.
+- config #9: storm-backed execution throughput — solve then EXECUTE against
+  the storm runner's in-process broker simulator (production backend wire
+  shapes, ``polls_to_finish=2``), reporting the execution flight recorder's
+  batch summary: ``execute_ms`` / ``moves_per_s`` plus the provenance path
+  histogram (relax/rounding/repair/greedy) the executed moves carried.
+  Measures the executor's submit/poll machinery, not broker I/O.
 
 ``vs_baseline`` = north-star-budget / measured (>1 ⇒ inside budget).
 ``vs_java`` is absent from every line: this image carries NO JVM (see
@@ -149,7 +155,7 @@ def _parse_only(argv):
         return {int(c) for c in raw.split(",")}
     except (IndexError, ValueError):
         sys.stderr.write("usage: bench.py [--only N[,N...]] [--trace] "
-                         "[--convergence]  (config numbers 1-8, e.g. "
+                         "[--convergence]  (config numbers 1-9, e.g. "
                          "--only 3 or --only 1,5)\n")
         raise SystemExit(2)
 
@@ -581,6 +587,11 @@ def run(backend: str, only=None) -> None:
     if want(8):
         _relax_rows(backend)
 
+    # ---- config #9: storm-backed execution throughput via the execution
+    # flight recorder.
+    if want(9):
+        _execution_rows(backend)
+
     if backend == "cpu":
         _replay_captured_tpu_rows()
 
@@ -763,6 +774,56 @@ def _relax_rows(backend: str, props=None, lanes=None,
                                  candidates=prev[1], waves=prev[2],
                                  tolerance=prev[3])
     del state, placement, opt
+
+
+def _execution_rows(backend: str, partitions: int = 48,
+                    polls_to_finish: int = 2) -> None:
+    """Config #9 (module docstring): end-to-end execution throughput on the
+    storm runner's in-process simulator stack — solve, then EXECUTE the
+    proposals against the production SubprocessClusterBackend wire shapes,
+    and report the execution flight recorder's drained batch summary:
+    ``execute_ms`` (batch wall from first submission to drain) and
+    ``moves_per_s`` (terminal moves over that wall), plus the provenance
+    path histogram the moves carried.  The row measures the executor's
+    poll/submit machinery, not broker I/O — the simulator completes a
+    movement after ``polls_to_finish`` polls."""
+    from cruise_control_tpu.fuzzsvc.scenario import generate_scenario
+    from cruise_control_tpu.fuzzsvc.storm import _wait_idle, build_storm_stack
+    from cruise_control_tpu.obsvc.execution import execution
+
+    rec = execution()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    rec.drain()                       # this row owns the next batch summary
+    sc = generate_scenario(3146, kind="exp_skew")
+    stack = build_storm_stack(sc, num_brokers=6, partitions=partitions,
+                              rf=2, polls_to_finish=polls_to_finish)
+    try:
+        t0 = time.monotonic()
+        res = stack.cc.rebalance(dryrun=False)
+        if not _wait_idle(stack.cc, timeout_s=120.0):
+            sys.stderr.write("config #9: executor never went idle; "
+                             "row skipped\n")
+            return
+        wall_s = time.monotonic() - t0
+        batches = rec.drain()
+        if not batches:
+            sys.stderr.write("config #9: no execution batch recorded; "
+                             "row skipped\n")
+            return
+        b = batches[-1]
+        _emit("storm_execution_throughput_6brokers_"
+              f"{partitions}partitions", wall_s, backend,
+              execute_ms=b["durationMs"],
+              moves_per_s=b["movesPerSecond"],
+              moves=b["moves"], completed=b["completed"],
+              dead=b["dead"], aborted=b["aborted"],
+              provenance_paths=b["pathHistogram"],
+              executed=bool(res.executed))
+    finally:
+        stack.cc.anomaly_detector.shutdown()
+        rec.configure(enabled=prev)
+        rec.reset()
 
 
 def _delta_propose_rows(backend: str, props=None, lanes: int = 16,

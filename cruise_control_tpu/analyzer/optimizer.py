@@ -38,6 +38,8 @@ from cruise_control_tpu.common.actions import ExecutionProposal, ProposalSummary
 from cruise_control_tpu.common.exceptions import OptimizationFailureError
 from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_telemetry
 from cruise_control_tpu.obsvc import convergence as _convergence
+from cruise_control_tpu.obsvc.execution import execution as _execution
+from cruise_control_tpu.obsvc.execution import path_histogram as _path_histogram
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
@@ -74,6 +76,17 @@ def _host_local_placement(placement):
     return jax.tree_util.tree_map(np.asarray, gathered)
 
 
+def _changed_partitions(part_ids, a, b):
+    """Partition ids whose placement (broker, leadership, or disk) differs
+    between two host-local placements — the execution observatory's per-goal
+    attribution diff.  Pure numpy over already-materialized outputs."""
+    n = part_ids.shape[0]
+    changed = ((np.asarray(a.broker)[:n] != np.asarray(b.broker)[:n])
+               | (np.asarray(a.is_leader)[:n] != np.asarray(b.is_leader)[:n])
+               | (np.asarray(a.disk)[:n] != np.asarray(b.disk)[:n]))
+    return set(np.unique(part_ids[changed]).tolist())
+
+
 @dataclass
 class OptimizerResult:
     """Reference: ``analyzer/OptimizerResult.java``."""
@@ -98,9 +111,9 @@ class OptimizerResult:
     def summary(self) -> ProposalSummary:
         return ProposalSummary.of(self.proposals)
 
-    def to_dict(self) -> Dict:
+    def to_dict(self, explain: bool = False) -> Dict:
         s = self.summary
-        return {
+        d = {
             **({"partial": True, "preemptReason": self.preempt_reason}
                if self.partial else {}),
             "numInterBrokerReplicaMovements": s.num_inter_broker_replica_movements,
@@ -128,6 +141,12 @@ class OptimizerResult:
                 for g in self.goal_infos
             ],
         }
+        if explain:
+            # ?explain=true: per-proposal provenance (goal / path / solve
+            # round / cost delta) plus the path histogram rollup.
+            d["proposals"] = [p.to_dict(explain=True) for p in self.proposals]
+            d["provenancePaths"] = _path_histogram(self.proposals)
+        return d
 
 
 def balancedness_score(goal_infos: Sequence[GoalOptimizationInfo],
@@ -378,6 +397,18 @@ class GoalOptimizer:
         agg = agg0
         bucket = f"R{gctx.state.num_replicas_padded}"
         preempt_reason = None
+        # Execution observatory: per-partition move provenance, built from
+        # host-local snapshots bracketing each goal (and polish) pass.  All
+        # numpy over already-materialized outputs — OFF-PATH for the solver:
+        # no executable, jit cache key, or proposal cache key changes either
+        # way (asserted by tests/test_execution_obs.py).
+        exec_rec = _execution()
+        prov_map: Optional[Dict[int, dict]] = None
+        if exec_rec.enabled:
+            exec_rec.clear_rounded()
+            prov_map = {}
+            part_ids = np.asarray(state.partition)[:meta.num_replicas]
+            prev_local = initial_local
         for gi, goal in enumerate(goals):
             # Goal-boundary budget check: covers cancel-only budgets (fused
             # executables, byte-identical to budget-less) and deadlines that
@@ -434,6 +465,34 @@ class GoalOptimizer:
                 if info.preempted:
                     gsp.set("preempted", info.preempt_reason)
             infos.append(info)
+            if prov_map is not None:
+                # Attribute this goal's placement changes.  Relaxed passes
+                # three-way diff through the stashed post-rounding placement:
+                # changed only before it = relax, only after = greedy repair,
+                # both = rounding.  Everything else (pure greedy, fallback)
+                # is one greedy diff.  Last writer wins across goals.
+                cur_local = _host_local_placement(placement)
+                base = {
+                    "goal": info.goal_name,
+                    "round": int(info.rounds),
+                    "costDelta": round(
+                        (info.metric_after - info.metric_before)
+                        / max(info.moves_applied, 1), 6),
+                }
+                rounded = exec_rec.pop_rounded(goal.name)
+                if rounded is not None and not info.relax_fallback:
+                    r_local = _host_local_placement(rounded)
+                    pre = _changed_partitions(part_ids, prev_local, r_local)
+                    post = _changed_partitions(part_ids, r_local, cur_local)
+                    for p in pre | post:
+                        path = ("rounding" if p in pre and p in post
+                                else "relax" if p in pre else "repair")
+                        prov_map[p] = dict(base, path=path)
+                else:
+                    for p in _changed_partitions(part_ids, prev_local,
+                                                 cur_local):
+                        prov_map[p] = dict(base, path="greedy")
+                prev_local = cur_local
             if info.preempted:
                 # A mid-goal preemption: the placement is the best found so
                 # far.  Skip the hard-goal/no-worsen verdicts — they judge
@@ -497,6 +556,19 @@ class GoalOptimizer:
                     psp.set("fresh_compiles", tel.compile_count() - c0)
                     psp.set("compile_ms", round(
                         (tel.compile_seconds_total() - s0) * 1000.0, 3))
+                if prov_map is not None:
+                    # Polish re-solves are pure greedy repairs of a soft
+                    # goal's band; their moves overwrite earlier attribution.
+                    cur_local = _host_local_placement(placement)
+                    for p in _changed_partitions(part_ids, prev_local,
+                                                 cur_local):
+                        prov_map[p] = {
+                            "goal": goal.name, "path": "greedy",
+                            "round": int(pinfo.rounds),
+                            "costDelta": round(
+                                (pinfo.metric_after - pinfo.metric_before)
+                                / max(pinfo.moves_applied, 1), 6)}
+                    prev_local = cur_local
                 for i, inf in enumerate(infos):
                     if inf.goal_name == goal.name:
                         inf.rounds += pinfo.rounds
@@ -512,7 +584,7 @@ class GoalOptimizer:
                 f"Solver.{inf.goal_name}.rounds").set(inf.rounds)
             registry().settable_gauge(
                 f"Solver.{inf.goal_name}.moves").set(inf.moves_applied)
-        _convergence().record_solve(
+        solve_id = _convergence().record_solve(
             [{"goal": inf.goal_name, "curve": inf.round_curve,
               "metric_before": inf.metric_before, "rounds": inf.rounds,
               "moves": inf.moves_applied,
@@ -538,7 +610,14 @@ class GoalOptimizer:
         final_local = _host_local_placement(placement)
         stats_after = compute_stats(state, final_local,
                                     self.constraint.balance_threshold)
-        proposals = diff_proposals(state, initial_local, final_local, meta)
+        if prov_map is not None:
+            # The convergence recorder's solve id lands only now (it records
+            # after the goal loop), so provenance records back-reference it
+            # post-hoc; None when round recording is off.
+            for rec in prov_map.values():
+                rec["solveId"] = solve_id
+        proposals = diff_proposals(state, initial_local, final_local, meta,
+                                   provenance=prov_map)
 
         result = OptimizerResult(
             proposals=proposals,

@@ -10,7 +10,7 @@ no per-replica Python in the common (unchanged) case.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -28,8 +28,13 @@ def diff_proposals(
     initial: Placement,
     final: Placement,
     meta: ClusterMeta,
+    provenance: Optional[Dict[int, dict]] = None,
 ) -> List[ExecutionProposal]:
-    """Proposals for every partition whose placement or leadership changed."""
+    """Proposals for every partition whose placement or leadership changed.
+
+    ``provenance`` (execution observatory) maps partition id → the
+    optimizer's per-move provenance record; when given, each proposal is
+    stamped with its partition's record."""
     n = meta.num_replicas
     part = np.asarray(state.partition)[:n]
     pos = np.asarray(state.pos)[:n]
@@ -132,5 +137,6 @@ def diff_proposals(
             old_leader=old_leader,
             old_replicas=tuple(old_list),
             new_replicas=tuple(new_list),
+            provenance=provenance.get(p) if provenance else None,
         ))
     return proposals

@@ -345,6 +345,14 @@ def optimize_goal_relaxed(solver: GoalSolver, goal: Goal,
     relax_ms = (time.monotonic() - t0) * 1000.0
     registry().counter(ATTEMPTS_SENSOR).inc()
 
+    # Execution observatory: park the post-rounding placement so the
+    # optimizer can split relax-stage moves from greedy-repair moves with a
+    # three-way diff.  Host-side only (the optimizer syncs it lazily);
+    # nothing here touches the solve executables or their cache keys.
+    from cruise_control_tpu.obsvc.execution import execution as _execution
+    if _execution().enabled:
+        _execution().stash_rounded(goal.name, rounded_pl)
+
     # Greedy repair from the rounded placement: the placement is a traced
     # input of the normal solve executable, so this compiles nothing new.
     pl2, agg2, info = solver.optimize_goal(goal, priors, gctx, rounded_pl,
@@ -354,7 +362,10 @@ def optimize_goal_relaxed(solver: GoalSolver, goal: Goal,
         or info.metric_after > float(metric0) * (1 + 1e-5) + 1e-9)
     if regressed:
         # The relaxation hurt this goal (possible when rounding's per-wave
-        # conservatism strands mass) — discard it entirely.
+        # conservatism strands mass) — discard it entirely.  The stashed
+        # rounding placement is void with it: the fallback pass is pure
+        # greedy from the original placement.
+        _execution().pop_rounded(goal.name)
         registry().counter(FALLBACKS_SENSOR).inc()
         pl2, agg2, info = solver.optimize_goal(goal, priors, gctx, placement,
                                                agg)

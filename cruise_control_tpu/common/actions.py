@@ -94,6 +94,12 @@ class ExecutionProposal:
     old_leader: ReplicaPlacementInfo
     old_replicas: Tuple[ReplicaPlacementInfo, ...]
     new_replicas: Tuple[ReplicaPlacementInfo, ...]
+    # Move provenance (execution observatory): {goal, path, round, solveId,
+    # costDelta} stamped by the optimizer when the recorder is on; None when
+    # it was off at solve time.  Excluded from eq/hash — two proposals that
+    # move the same replicas the same way are the same proposal regardless
+    # of which solve produced them.
+    provenance: Optional[dict] = field(default=None, compare=False)
 
     @property
     def new_leader(self) -> ReplicaPlacementInfo:
@@ -132,13 +138,16 @@ class ExecutionProposal:
     def inter_broker_data_to_move(self) -> float:
         return self.partition_size * len(self.replicas_to_add)
 
-    def to_dict(self) -> dict:
-        return {
+    def to_dict(self, explain: bool = False) -> dict:
+        d = {
             "topicPartition": str(self.topic_partition),
             "oldLeader": self.old_leader.broker_id,
             "oldReplicas": [r.broker_id for r in self.old_replicas],
             "newReplicas": [r.broker_id for r in self.new_replicas],
         }
+        if explain and self.provenance is not None:
+            d["provenance"] = self.provenance
+        return d
 
 
 @dataclass

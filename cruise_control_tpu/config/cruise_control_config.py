@@ -358,6 +358,28 @@ def _trace_def() -> ConfigDef:
              doc="memory-headroom objective: the device-buffer ledger's "
                  "tracked utilization (Memory.device-utilization, live bytes "
                  "/ device budget) must stay below this fraction")
+    d.define("slo.execution.seconds.per.move.max", ConfigType.DOUBLE, 60.0,
+             range_validator(0.001),
+             doc="execution-throughput objective: the executor flight "
+                 "recorder's EWMA seconds-per-move "
+                 "(Executor.seconds-per-move) must stay below this; the "
+                 "gauge reads 0.0 between batches so idle never burns")
+    d.define("execution.observatory.enabled", ConfigType.BOOLEAN, True,
+             doc="run the execution flight recorder: move provenance "
+                 "threaded from the optimizer into executor tasks and the "
+                 "journal, per-broker inflight accounting, EWMA "
+                 "move-completion throughput and batch ETA "
+                 "(GET /execution_progress, Executor.* throughput sensors). "
+                 "Host-side only: solver executables and jit cache keys are "
+                 "byte-identical with the observatory off")
+    d.define("execution.history.ring.size", ConfigType.INT, 64,
+             range_validator(1),
+             doc="bounded ring of recent execution-batch summaries the "
+                 "flight recorder retains for /execution_progress")
+    d.define("execution.throughput.ewma.alpha", ConfigType.DOUBLE, 0.3,
+             range_validator(0.0001, 1.0),
+             doc="EWMA smoothing factor for the seconds-per-move estimator "
+                 "(higher = reacts faster to the latest completion)")
     return d
 
 

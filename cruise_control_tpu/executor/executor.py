@@ -36,6 +36,7 @@ from cruise_control_tpu.executor.tasks import (
 )
 from cruise_control_tpu.obsvc import oplog as _oplog
 from cruise_control_tpu.obsvc.audit import audit_log
+from cruise_control_tpu.obsvc.execution import execution as _execution
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 
 LOG = logging.getLogger(__name__)
@@ -43,6 +44,12 @@ LOG = logging.getLogger(__name__)
 # KafkaCruiseControlUtils / Executor.java:945): execution lifecycle events on
 # their own logger name so deployments can route them to an audit sink.
 OPERATION_LOG = logging.getLogger("cruisecontrol.operation")
+
+# Floor for poll loops that spin against an UNAVAILABLE backend (paused
+# circuit, journal adoption): storms tune progress_check_interval_s down to
+# sub-millisecond for throughput, but a dead-peer probe at that cadence is a
+# busy-wait.  The movement hot loops deliberately poll unfloored.
+_POLL_FLOOR_S = 0.01
 
 
 class ExecutorState(enum.Enum):
@@ -118,6 +125,7 @@ class Executor:
         self.journal: Optional[ExecutionJournal] = None
         self.recovering = False
         self.last_journal_recovery: Optional[Dict] = None
+        self._batch_meta: Dict = {"principal": None, "requestId": None}
         self._register_sensors()
 
     def _register_sensors(self) -> None:
@@ -131,6 +139,11 @@ class Executor:
 
         def task_count(task_type, state):
             def read():
+                # Stale-gauge guard: the tracker is lifetime-cumulative, so a
+                # finished batch's terminal states (aborted/dead) would stick
+                # forever — the action gauges report the live batch only.
+                if not self.has_ongoing_execution:
+                    return 0
                 return self.tracker.summary().get(task_type.value, {}).get(
                     state.value, 0)
             return read
@@ -225,9 +238,16 @@ class Executor:
             accepted = list(self._planner.add_proposals(list(proposals)[:total]))
             for t in accepted:
                 self.tracker.add(t)
+            # Per-tenant attribution: the requesting principal / correlation
+            # id ride the request contextvars into this call (the servlet's
+            # UserTaskManager copies the request context), and from here
+            # into the journal batch_start line, the executor.batch span,
+            # and the flight recorder's batch record.
+            self._batch_meta = {"principal": _oplog.current_principal(),
+                                "requestId": _oplog.current_request_id()}
             if self.journal is not None:
                 try:
-                    self.journal.begin_batch(accepted)
+                    self.journal.begin_batch(accepted, meta=self._batch_meta)
                 except OSError:
                     LOG.exception("journal begin_batch failed; executing "
                                   "without crash protection")
@@ -239,12 +259,16 @@ class Executor:
                             ExecutionTaskState.DEAD,
                             ExecutionTaskState.ABORTED)},
                 self.tracker.finished_data_movement_mb)
+        _execution().begin_batch(
+            accepted, principal=self._batch_meta["principal"],
+            request_id=self._batch_meta["requestId"])
         self._sensor_started.inc()
         OPERATION_LOG.info(
             "execution started: %d tasks (%d proposals requested, cap %d)",
             total, len(proposals), self.config.max_num_cluster_movements)
         _oplog.record("start", endpoint="executor.batch",
-                      tasks=total, proposals=len(proposals))
+                      tasks=total, proposals=len(proposals),
+                      request_id=self._batch_meta["requestId"])
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="proposal-execution")
         self._thread.start()
@@ -272,7 +296,10 @@ class Executor:
                 self._state = s
 
     def _transition(self, task: ExecutionTask, to: ExecutionTaskState) -> None:
-        """Tracker transition + write-ahead journal record (when enabled)."""
+        """Tracker transition + write-ahead journal record (when enabled).
+        The flight recorder observes BEFORE the tracker mutates task.state,
+        so it sees both ends of the transition."""
+        _execution().on_transition(task, to, self._now_ms())
         self.tracker.transition(task, to, self._now_ms())
         if self.journal is not None:
             try:
@@ -300,7 +327,7 @@ class Executor:
                 OPERATION_LOG.info(
                     "execution resumed: admin backend recovered")
                 return True
-            time.sleep(max(self.config.progress_check_interval_s, 0.01))
+            self._poll_sleep(floored=True)
         return False
 
     # ----------------------------------------------------- journal recovery
@@ -360,7 +387,7 @@ class Executor:
             deadline = self._clock() + adoption_timeout_s
             while (adopted and self._clock() < deadline
                    and not self._stop_requested.is_set()):
-                time.sleep(max(self.config.progress_check_interval_s, 0.01))
+                self._poll_sleep(floored=True)
                 try:
                     for t in adopted:
                         self.backend.finished(live[t.execution_id])
@@ -391,8 +418,13 @@ class Executor:
 
     def _run(self) -> None:
         # Root span: the execution thread has no request context, so each
-        # batch is its own trace (phases + outcome counts as attrs).
-        with _obsvc_tracer().span("executor.batch"):
+        # batch is its own trace (phases + outcome counts as attrs); the
+        # requesting principal / correlation id captured at accept time are
+        # re-attached here for cross-referencing with the http.* span.
+        attrs = {k: v for k, v in (("principal", self._batch_meta["principal"]),
+                                   ("request_id", self._batch_meta["requestId"]))
+                 if v is not None}
+        with _obsvc_tracer().span("executor.batch", **attrs):
             self._run_impl()
 
     def _run_impl(self) -> None:
@@ -465,6 +497,15 @@ class Executor:
                                  ExecutionTaskState.DEAD,
                                  ExecutionTaskState.ABORTED)}
             moved_mb = self.tracker.finished_data_movement_mb - base_mb
+            # Close the flight recorder's batch: throughput summary + the
+            # provenance-path histogram roll into the oplog line, the batch
+            # span, and the self-healing audit entry below.
+            exec_summary = _execution().end_batch(
+                completed=counts[ExecutionTaskState.COMPLETED],
+                dead=counts[ExecutionTaskState.DEAD],
+                aborted=counts[ExecutionTaskState.ABORTED],
+                moved_mb=moved_mb) or {}
+            paths = exec_summary.get("pathHistogram") or {}
             OPERATION_LOG.info(
                 "execution finished: completed=%d dead=%d aborted=%d "
                 "moved=%.1fMB",
@@ -478,13 +519,18 @@ class Executor:
                 completed=counts[ExecutionTaskState.COMPLETED],
                 dead=counts[ExecutionTaskState.DEAD],
                 aborted=counts[ExecutionTaskState.ABORTED],
-                moved_mb=round(moved_mb, 1))
+                moved_mb=round(moved_mb, 1),
+                moves=exec_summary.get("moves"),
+                request_id=self._batch_meta["requestId"],
+                **paths)
             span = _obsvc_tracer().current()
             if span is not None:
                 span.set("completed", counts[ExecutionTaskState.COMPLETED])
                 span.set("dead", counts[ExecutionTaskState.DEAD])
                 span.set("aborted", counts[ExecutionTaskState.ABORTED])
                 span.set("moved_mb", round(moved_mb, 1))
+                if paths:
+                    span.set("provenance_paths", dict(paths))
             # Stage 3 of the self-healing audit: attach this batch's outcome
             # to the entry whose fix started it (no-op for user-triggered
             # executions with no pending entry).
@@ -492,7 +538,8 @@ class Executor:
                 completed=counts[ExecutionTaskState.COMPLETED],
                 dead=counts[ExecutionTaskState.DEAD],
                 aborted=counts[ExecutionTaskState.ABORTED],
-                moved_mb=moved_mb)
+                moved_mb=moved_mb,
+                provenance_paths=paths or None)
             if self.journal is not None:
                 try:
                     self.journal.end_batch(
@@ -509,6 +556,12 @@ class Executor:
 
     def _now_ms(self) -> float:
         return self._clock() * 1000.0
+
+    def _poll_sleep(self, floored: bool = False) -> None:
+        """One progress-poll interval; ``floored`` clamps to
+        :data:`_POLL_FLOOR_S` for loops probing an unavailable backend."""
+        interval = self.config.progress_check_interval_s
+        time.sleep(max(interval, _POLL_FLOOR_S) if floored else interval)
 
     def _concurrency(self) -> int:
         return (self.adjuster.current if self.config.auto_adjust_concurrency
@@ -536,7 +589,9 @@ class Executor:
                     self._transition(t, ExecutionTaskState.IN_PROGRESS)
                     self._transition(t, ExecutionTaskState.DEAD)
                 if self.config.auto_adjust_concurrency:
-                    self.adjuster.on_distress()
+                    _execution().record_tuner(
+                        "decrease", "submit-failure",
+                        self.adjuster.on_distress())
                 return False
         # Stop requested before the batch went out: it is no longer in the
         # planner (batch_fn popped it), so account for it here.
@@ -578,7 +633,7 @@ class Executor:
                 if not batch and self._planner_queue_empty(task_type):
                     break
                 continue
-            time.sleep(self.config.progress_check_interval_s)
+            self._poll_sleep()
             still_active: List[ExecutionTask] = []
             paused = False
             for idx, t in enumerate(active):
@@ -606,12 +661,15 @@ class Executor:
                     for b in t.brokers_involved:
                         in_flight[b] = max(in_flight.get(b, 0) - 1, 0)
                     if self.config.auto_adjust_concurrency:
-                        self.adjuster.on_distress()
+                        _execution().record_tuner(
+                            "decrease", "task-dead",
+                            self.adjuster.on_distress())
                 else:
                     still_active.append(t)
             if (not paused and self.config.auto_adjust_concurrency
                     and not still_active):
-                self.adjuster.on_healthy()
+                _execution().record_tuner("increase", "batch-drained",
+                                          self.adjuster.on_healthy())
             active = still_active
         # Stop requested: abort whatever is in flight.
         for t in active:
@@ -647,7 +705,7 @@ class Executor:
                 self._transition(t, ExecutionTaskState.IN_PROGRESS)
             pending = list(batch)
             while pending and not self._stop_requested.is_set():
-                time.sleep(self.config.progress_check_interval_s)
+                self._poll_sleep()
                 still = []
                 for idx, t in enumerate(pending):
                     try:

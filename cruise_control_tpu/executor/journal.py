@@ -108,8 +108,11 @@ class ExecutionJournal:
 
     # -- write side --------------------------------------------------------
 
-    def begin_batch(self, tasks) -> int:
-        """Record batch acceptance BEFORE the first backend submission."""
+    def begin_batch(self, tasks, meta: Optional[dict] = None) -> int:
+        """Record batch acceptance BEFORE the first backend submission.
+        ``meta`` (e.g. the requesting principal / X-Request-ID) merges into
+        the batch_start record; ``replay()`` readers use ``.get`` so older
+        journals without it stay readable."""
         with self._lock:
             self._close_locked()
             batch_id = int(time.time() * 1000)
@@ -119,6 +122,7 @@ class ExecutionJournal:
                 "event": "batch_start",
                 "batchId": batch_id,
                 "tsMs": batch_id,
+                **{k: v for k, v in (meta or {}).items() if v is not None},
                 "tasks": [self._task_record(t) for t in tasks],
             }
             self._f.write(json.dumps(record) + "\n")
@@ -129,7 +133,7 @@ class ExecutionJournal:
     @staticmethod
     def _task_record(task) -> dict:
         p = task.proposal
-        return {
+        rec = {
             "tid": task.execution_id,
             "type": task.task_type.value,
             "topic": p.topic_partition.topic,
@@ -138,6 +142,11 @@ class ExecutionJournal:
             "newReplicas": [[r.broker_id, r.logdir] for r in p.new_replicas],
             "state": task.state.value,
         }
+        if getattr(p, "provenance", None) is not None:
+            # Move provenance rides the journal line so a crash-recovered
+            # batch keeps its decision lineage (replay tolerates absence).
+            rec["provenance"] = p.provenance
+        return rec
 
     def record_transition(self, task, to_state) -> None:
         with self._lock:

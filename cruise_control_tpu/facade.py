@@ -109,14 +109,14 @@ class OperationResult:
     # and returned the best placement found so far instead of converging.
     partial: bool = False
 
-    def to_dict(self) -> Dict:
+    def to_dict(self, explain: bool = False) -> Dict:
         d = {"dryrun": self.dryrun, "executed": self.executed, "info": self.info}
         if self.degraded:
             d["degraded"] = True
         if self.partial:
             d["partial"] = True
         if self.optimizer_result is not None:
-            d["result"] = self.optimizer_result.to_dict()
+            d["result"] = self.optimizer_result.to_dict(explain=explain)
         return d
 
 
@@ -1108,10 +1108,14 @@ class CruiseControl:
         """GET /state aggregation (CruiseControlState.java)."""
         runner_state = (self.task_runner.state.value
                         if self.task_runner is not None else "NOT_STARTED")
+        from cruise_control_tpu.obsvc.execution import execution as _execution
         from cruise_control_tpu.obsvc.memory import memory_ledger
         return {
             "MonitorState": self.load_monitor.state(runner_state).to_dict(),
-            "ExecutorState": self.executor.state_summary(),
+            "ExecutorState": {
+                **self.executor.state_summary(),
+                "executionState": _execution().state_summary(),
+            },
             "AnomalyDetectorState": self.anomaly_detector.state_summary(),
             "AnalyzerState": {
                 "isProposalReady": True,

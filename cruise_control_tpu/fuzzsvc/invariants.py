@@ -538,6 +538,47 @@ def relaxation_sound(m: Materialized) -> List[str]:
     return out
 
 
+def provenance_complete(m: Materialized) -> List[str]:
+    """Execution-observatory provenance is total on this scenario: with the
+    flight recorder on, every proposal the optimizer emits resolves to
+    exactly one provenance record whose path is a known pipeline stage
+    (relax/rounding/repair/greedy), naming a goal the solve actually ran,
+    and the path histogram sums to the proposal count — no move can reach
+    the executor without a decision lineage."""
+    from cruise_control_tpu.obsvc.execution import (
+        PATHS, execution, path_histogram)
+
+    rec = execution()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    try:
+        res = GoalOptimizer(goal_names=list(m.scenario.goal_names)
+                            ).optimizations(m.state, m.placement, m.meta)
+    finally:
+        rec.configure(enabled=prev)
+    out: List[str] = []
+    solved = {i.goal_name for i in res.goal_infos}
+    for p in res.proposals:
+        prov = getattr(p, "provenance", None)
+        if not prov:
+            out.append(f"{p.topic_partition}: move without provenance")
+            continue
+        if prov.get("path") not in PATHS:
+            out.append(f"{p.topic_partition}: unknown provenance path "
+                       f"{prov.get('path')!r}")
+        if prov.get("goal") not in solved:
+            out.append(f"{p.topic_partition}: provenance goal "
+                       f"{prov.get('goal')!r} was never solved")
+    hist = path_histogram(res.proposals)
+    if sum(hist.values()) != len(res.proposals):
+        out.append(f"path histogram {hist} sums to {sum(hist.values())} "
+                   f"!= {len(res.proposals)} proposals")
+    if hist.get("unknown"):
+        out.append(f"{hist['unknown']} moves fell into the 'unknown' "
+                   "provenance bucket")
+    return out
+
+
 INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "hard_goals_never_worsen": hard_goals_never_worsen,
     "soft_goals_no_regression": soft_goals_no_regression,
@@ -548,6 +589,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "partial_solve_safe": partial_solve_safe,
     "relaxation_sound": relaxation_sound,
     "memory_ledger_balanced": memory_ledger_balanced,
+    "provenance_complete": provenance_complete,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

@@ -10,6 +10,10 @@ Public surface:
   deque append per anomaly decision).
 * :func:`convergence` — the solver convergence flight recorder (per-round
   curves; disabled until ``trace.solver.rounds=true``).
+* :func:`execution` — the execution flight recorder (move provenance,
+  throughput/ETA, AIMD tuner events; on by default,
+  ``execution.observatory.enabled``; GET /execution_progress,
+  ``Executor.*`` throughput sensors).
 * :func:`history` — the sensor history sampler (bounded per-sensor
   time-series rings; on by default, ``obs.history.enabled``).
 * :func:`memory_ledger` — the device-buffer & executable-cost ledgers
@@ -26,6 +30,8 @@ from __future__ import annotations
 
 from cruise_control_tpu.obsvc.audit import AuditLog, audit_log
 from cruise_control_tpu.obsvc.convergence import ConvergenceRecorder, convergence
+from cruise_control_tpu.obsvc.execution import (ExecutionFlightRecorder,
+                                                execution)
 from cruise_control_tpu.obsvc.history import HistoryRecorder, history
 from cruise_control_tpu.obsvc.memory import (DeviceMemoryLedger,
                                              ExecutableCostLedger,
@@ -33,9 +39,10 @@ from cruise_control_tpu.obsvc.memory import (DeviceMemoryLedger,
 from cruise_control_tpu.obsvc.tracer import Span, Tracer, tracer
 
 __all__ = ["AuditLog", "ConvergenceRecorder", "DeviceMemoryLedger",
-           "ExecutableCostLedger", "HistoryRecorder", "Span",
-           "Tracer", "audit_log", "configure", "convergence", "cost_ledger",
-           "history", "memory_ledger", "tracer"]
+           "ExecutableCostLedger", "ExecutionFlightRecorder",
+           "HistoryRecorder", "Span", "Tracer", "audit_log", "configure",
+           "convergence", "cost_ledger", "execution", "history",
+           "memory_ledger", "tracer"]
 
 
 def configure(config) -> Tracer:
@@ -60,6 +67,11 @@ def configure(config) -> Tracer:
     _solver.set_round_recording(record_rounds)
     convergence().configure(enabled=record_rounds,
                             ring_size=int(config.get("trace.solver.ring.size")))
+
+    execution().configure(
+        enabled=bool(config.get("execution.observatory.enabled")),
+        ring_size=int(config.get("execution.history.ring.size")),
+        alpha=float(config.get("execution.throughput.ewma.alpha")))
 
     _memory.configure(config)
 

@@ -75,10 +75,15 @@ class AuditLog:
                     return
 
     def attach_execution_outcome(self, completed: int, dead: int,
-                                 aborted: int, moved_mb: float) -> None:
+                                 aborted: int, moved_mb: float,
+                                 provenance_paths: Optional[Dict[str, int]]
+                                 = None) -> None:
         """Stage 3: executor batch finished.  Attach to the newest entry
         whose fix started an execution and has no outcome yet; executions
-        started directly by users (no pending audit entry) are dropped."""
+        started directly by users (no pending audit entry) are dropped.
+        ``provenance_paths`` (execution observatory) is the batch's
+        relax/rounding/repair/greedy move histogram — how the fix's moves
+        were derived, joined to how they landed."""
         with self._lock:
             for entry in reversed(self._entries):
                 if (entry["outcome"] == "FIX_STARTED"
@@ -90,6 +95,9 @@ class AuditLog:
                         "movedMB": round(moved_mb, 1),
                         "timestampMs": int(time.time() * 1000),
                     }
+                    if provenance_paths:
+                        entry["executionOutcome"]["provenancePaths"] = dict(
+                            provenance_paths)
                     return
 
     def entries(self) -> List[Dict[str, Any]]:

@@ -48,6 +48,25 @@ def current_principal() -> str:
     return _principal.get()
 
 
+# Request correlation: the servlet binds the request's X-Request-ID here
+# (minted when absent), and the UserTaskManager worker inherits it via
+# contextvars.copy_context() — so the executor can label its batch span,
+# journal batch_start line, and flight-recorder batch with the request that
+# asked for the moves (the multi-tenant attribution hook).
+_request_id: ContextVar[str | None] = ContextVar("cc_operation_request_id",
+                                                 default=None)
+
+
+def set_request_id(request_id: str | None):
+    """Bind the correlation id for this request context; returns the
+    contextvar token."""
+    return _request_id.set(request_id or None)
+
+
+def current_request_id() -> str | None:
+    return _request_id.get()
+
+
 def _fmt(value) -> str:
     s = str(value)
     # One event per line is the whole point — never let a value break it.

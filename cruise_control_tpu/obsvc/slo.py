@@ -49,7 +49,7 @@ class SloObjective:
 
 
 def objectives_from_config(config) -> List[SloObjective]:
-    """The four built-in objectives, thresholds from ``slo.*`` keys."""
+    """The five built-in objectives, thresholds from ``slo.*`` keys."""
     return [
         SloObjective(
             name="memory-headroom",
@@ -67,6 +67,13 @@ def objectives_from_config(config) -> List[SloObjective]:
             name="solve-rounds",
             pattern="Solver.*.rounds",
             threshold=float(config.get("slo.solve.rounds.max"))),
+        SloObjective(
+            # Execution throughput, inverted so "bad" is ABOVE threshold:
+            # the gauge is the flight recorder's EWMA seconds-per-move,
+            # which reads 0.0 while no batch is live — idle never burns.
+            name="execution-throughput",
+            pattern="Executor.seconds-per-move",
+            threshold=float(config.get("slo.execution.seconds.per.move.max"))),
     ]
 
 

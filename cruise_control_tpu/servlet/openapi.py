@@ -43,6 +43,9 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("excluded_topics", "string", "regex of topics to exclude"),
         ("deadline_ms", "number", "wall-clock solve budget; on expiry the "
          "best-so-far placement returns tagged partial"),
+        ("explain", "boolean", "include per-move provenance (originating "
+         "goal, solve round/id, relax/rounding/repair/greedy path, cost "
+         "delta) and the provenancePaths histogram in the response"),
     ], "USER"),
     "bootstrap": ("Re-ingest historical samples", [
         ("start", "number", "range start ms"),
@@ -73,6 +76,16 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("limit", "integer", "max series returned (default 64, cap 1024); "
          "truncated=true in the body when matches were dropped"),
     ], "VIEWER"),
+    "execution_progress": ("Execution observatory: the active batch's "
+                           "per-task live state joined with each move's "
+                           "provenance record (originating goal, solve "
+                           "round/id, relax/rounding/repair/greedy path, "
+                           "cost delta), per-broker inflight counts, the "
+                           "EWMA moves-per-second throughput and batch ETA, "
+                           "recent batch summaries and AIMD concurrency-"
+                           "tuner events; 404 while "
+                           "execution.observatory.enabled=false", [],
+                           "VIEWER"),
     "memory": ("Device-memory observatory: per-subsystem live-bytes ledger, "
                "backend reconciliation, headroom-guard shrink/refusal "
                "counters, and per-executable compile-cost rows "
@@ -106,6 +119,8 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
          "restrict to immigrant replicas"),
         ("deadline_ms", "number", "wall-clock solve budget; on expiry the "
          "best-so-far placement returns tagged partial"),
+        ("explain", "boolean", "include per-move provenance and the "
+         "provenancePaths histogram in the response"),
     ], "ADMIN"),
     "add_broker": ("Move load onto new brokers", [
         ("brokerid", "string", "comma list of broker ids"),
@@ -113,6 +128,7 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("goals", "string", "comma list of goal names"),
         ("throttle_added_broker", "boolean", "apply replication throttle"),
         ("deadline_ms", "number", "wall-clock solve budget"),
+        ("explain", "boolean", "include per-move provenance in the response"),
     ], "ADMIN"),
     "remove_broker": ("Decommission brokers", [
         ("brokerid", "string", "comma list of broker ids"),
@@ -120,16 +136,19 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("goals", "string", "comma list of goal names"),
         ("destination_broker_ids", "string", "comma list of allowed targets"),
         ("deadline_ms", "number", "wall-clock solve budget"),
+        ("explain", "boolean", "include per-move provenance in the response"),
     ], "ADMIN"),
     "demote_broker": ("Shed leadership from brokers", [
         ("brokerid", "string", "comma list of broker ids"),
         ("dryrun", "boolean", "propose only"),
         ("deadline_ms", "number", "wall-clock solve budget"),
+        ("explain", "boolean", "include per-move provenance in the response"),
     ], "ADMIN"),
     "fix_offline_replicas": ("Re-replicate offline replicas", [
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
         ("deadline_ms", "number", "wall-clock solve budget"),
+        ("explain", "boolean", "include per-move provenance in the response"),
     ], "ADMIN"),
     "topic_configuration": ("Change topic replication factor", [
         ("topic", "string", "topic regex"),
@@ -137,6 +156,7 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
         ("dryrun", "boolean", "propose only"),
         ("goals", "string", "comma list of goal names"),
         ("deadline_ms", "number", "wall-clock solve budget"),
+        ("explain", "boolean", "include per-move provenance in the response"),
     ], "ADMIN"),
     "cancel_user_task": ("Abort an in-flight 202 operation: fires its solve "
                          "budget's cancellation token; the solve stops at "
@@ -251,6 +271,12 @@ def build_spec() -> Dict:
             responses["404"] = {
                 "description": "memory ledger disabled (memory.enabled="
                                "false)",
+                "content": {"application/json": {"schema":
+                            {"$ref": "#/components/schemas/Error"}}}}
+        if endpoint == "execution_progress":
+            responses["404"] = {
+                "description": "execution observatory disabled "
+                               "(execution.observatory.enabled=false)",
                 "content": {"application/json": {"schema":
                             {"$ref": "#/components/schemas/Error"}}}}
         if endpoint == "profile":

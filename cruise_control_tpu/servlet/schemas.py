@@ -166,6 +166,13 @@ OPERATION_RESULT_SCHEMA = {
                                      "violatedBrokersAfter"],
                     },
                 },
+                # ?explain=true only: per-move provenance and the
+                # relax/rounding/repair/greedy path histogram.
+                "proposals": {"type": "array", "items": {"type": "object"}},
+                "provenancePaths": {
+                    "type": "object",
+                    "additionalProperties": {"type": "integer"},
+                },
             },
         },
     },
@@ -432,6 +439,71 @@ METRICS_HISTORY_SCHEMA = {
     },
 }
 
+_PROVENANCE_SCHEMA = {
+    # Move provenance: which goal's solve emitted the move and through
+    # which pipeline path it reached the final placement.
+    "type": ["object", "null"],
+    "properties": {
+        "goal": {"type": "string"},
+        "round": {"type": "integer"},
+        "solveId": {"type": ["integer", "null"]},
+        "path": {"type": "string",
+                 "enum": ["relax", "rounding", "repair", "greedy"]},
+        "costDelta": {"type": "number"},
+    },
+}
+
+EXECUTION_PROGRESS_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "active", "version"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "active": {"type": "boolean"},
+        "batch": {
+            "type": "object",
+            "properties": {
+                "executionId": {"type": "integer"},
+                "startedMs": {"type": "number"},
+                "principal": {"type": ["string", "null"]},
+                "requestId": {"type": ["string", "null"]},
+                "total": {"type": "integer"},
+                "pathHistogram": {"type": "object",
+                                  "additionalProperties": {"type": "integer"}},
+                "tunerIncreases": {"type": "integer"},
+                "tunerDecreases": {"type": "integer"},
+            },
+        },
+        "tasks": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["topicPartition", "type", "state"],
+                "properties": {
+                    "topicPartition": {"type": "string"},
+                    "type": {"type": "string"},
+                    "state": {"type": "string"},
+                    "provenance": _PROVENANCE_SCHEMA,
+                },
+            },
+        },
+        "throughput": {
+            "type": "object",
+            "properties": {
+                "completed": {"type": "integer"},
+                "remaining": {"type": "integer"},
+                "inflight": {"type": "integer"},
+                "secondsPerMove": {"type": ["number", "null"]},
+                "movesPerSecond": {"type": ["number", "null"]},
+                "etaSeconds": {"type": ["number", "null"]},
+            },
+        },
+        "inflightPerBroker": {"type": "object",
+                              "additionalProperties": {"type": "integer"}},
+        "tunerEvents": {"type": "array", "items": {"type": "object"}},
+        "recentBatches": {"type": "array", "items": {"type": "object"}},
+    },
+}
+
 _HEALTH_PROBE_SCHEMA = {
     "type": "object",
     "required": ["status"],
@@ -486,5 +558,6 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "trace": TRACE_SCHEMA,
     "profile": PROFILE_SCHEMA,
     "memory": MEMORY_SCHEMA,
+    "execution_progress": EXECUTION_PROGRESS_SCHEMA,
     "health": HEALTH_SCHEMA,
 }

@@ -50,7 +50,8 @@ REQUEST_ID_HEADER = "X-Request-ID"
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
                  "metrics", "compile_cache", "trace", "health",
-                 "solver_stats", "metrics/history", "memory", "profile"}
+                 "solver_stats", "metrics/history", "memory", "profile",
+                 "execution_progress"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -487,6 +488,18 @@ class CruiseControlApp:
                                   "(memory.enabled=false)"}, {}
         return 200, ledger.snapshot(), {}
 
+    def _ep_execution_progress(self, params, task_id):
+        """Execution observatory: the active batch's per-task state joined
+        with each move's provenance record, per-broker inflight counts, the
+        EWMA throughput/ETA estimate, recent batch summaries and AIMD tuner
+        events (404 while execution.observatory.enabled=false)."""
+        from cruise_control_tpu.obsvc.execution import execution
+        rec = execution()
+        if not rec.enabled:
+            return 404, {"error": "execution observatory disabled "
+                                  "(execution.observatory.enabled=false)"}, {}
+        return 200, rec.progress(), {}
+
     # ---- async operations (202-until-done)
 
     def _async(self, endpoint: str, params: Dict[str, str], task_id: Optional[str],
@@ -514,10 +527,14 @@ class CruiseControlApp:
                 lambda f, t=task, e=endpoint, q=query, p=_oplog.current_principal():
                 self._oplog_outcome(t, e, q, p))
         headers = {USER_TASK_HEADER: task.task_id}
+        # ?explain=true is a render-time flag, not part of the operation:
+        # re-polling a cached task with a different explain value re-renders
+        # the same result, it never re-runs the solve.
+        explain = _bool(params, "explain", False)
         if task.state is TaskState.ACTIVE:
             try:
                 result = task.future.result(timeout=5.0)
-                return 200, self._render(result), headers
+                return 200, self._render(result, explain), headers
             except concurrent.futures.TimeoutError:
                 # On 3.11+ this is the builtin TimeoutError; on 3.10 it is a
                 # distinct class, and catching only the builtin returned 500
@@ -530,7 +547,7 @@ class CruiseControlApp:
             e = task.future.exception()
             code = 409 if isinstance(e, OngoingExecutionError) else 500
             return code, {"error": type(e).__name__, "message": str(e)}, headers
-        return 200, self._render(task.future.result()), headers
+        return 200, self._render(task.future.result(), explain), headers
 
     @staticmethod
     def _oplog_outcome(task, endpoint: str, query: str,
@@ -561,8 +578,13 @@ class CruiseControlApp:
             LOG.exception("operation log emit failed")
 
     @staticmethod
-    def _render(result) -> Dict:
-        return result.to_dict() if hasattr(result, "to_dict") else {"result": result}
+    def _render(result, explain: bool = False) -> Dict:
+        if not hasattr(result, "to_dict"):
+            return {"result": result}
+        try:
+            return result.to_dict(explain=explain)
+        except TypeError:   # result types without an explain view
+            return result.to_dict()
 
     def _ep_proposals(self, params, task_id):
         goals = _goals(params)
@@ -760,6 +782,9 @@ def _make_handler(app: CruiseControlApp):
             # operators can correlate across proxies), mint one otherwise;
             # the root span carries it into /trace.
             request_id = self.headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex[:16]
+            # Bind it alongside the principal so user-task workers (copied
+            # context) stamp executor batches with the originating request.
+            _oplog.set_request_id(request_id)
             with _obsvc_tracer().span(f"http.{endpoint}", method=method,
                                       request_id=request_id):
                 try:

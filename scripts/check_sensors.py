@@ -146,6 +146,11 @@ def collect_live(timeout_s: float = 90.0):
         status, _, _ = get("/memory")
         if status != 200:
             raise RuntimeError(f"/memory not serving: {status}")
+        # Same for the execution observatory (also default-on): the gauges
+        # must read idle zeros through a live /execution_progress drive.
+        status, _, _ = get("/execution_progress")
+        if status != 200:
+            raise RuntimeError(f"/execution_progress not serving: {status}")
         _, body, _ = get("/metrics?json=true")
         _, text, _ = get("/metrics")
         return json.loads(body)["sensors"], text
@@ -161,6 +166,10 @@ def collect_live(timeout_s: float = 90.0):
         from cruise_control_tpu.obsvc.memory import memory_ledger
         memory_ledger().reset()
         memory_ledger().configure(enabled=False)
+        # The execution flight recorder defaults ON — reset its rings but
+        # leave it enabled (that IS the default state).
+        from cruise_control_tpu.obsvc.execution import execution
+        execution().reset()
 
 
 def main() -> int:

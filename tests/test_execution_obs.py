@@ -1,0 +1,239 @@
+"""Execution observatory tests (docs/EXECUTION.md).
+
+Covers the off-path guarantee (solver jit-cache keys bitwise identical with
+the observatory on or off), the provenance stamping + explain rendering, the
+flight recorder's EWMA/ETA/inflight bookkeeping, and the joined
+provenance-with-live-progress view during a storm-runner execution.
+"""
+
+import time
+
+import pytest
+
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskState,
+    TaskType,
+)
+from cruise_control_tpu.obsvc.execution import (
+    PATHS,
+    ExecutionFlightRecorder,
+    execution,
+    path_histogram,
+)
+from tests.test_executor import proposal
+
+
+# ------------------------------------------------------- off-path guarantee
+
+
+def test_observatory_off_path_cache_keys_bitwise_identical():
+    """Acceptance: the observatory is host-side numpy over materialized
+    snapshots — flipping it on compiles NOTHING new and perturbs NO existing
+    jit-cache key.  (Contrast PR-9's round recorder, which adds separate
+    keyed executables; this one must add none at all.)"""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import solver as solver_mod
+    from cruise_control_tpu.testing import deterministic as det
+
+    rec = execution()
+    prev = rec.enabled
+    state, placement, meta = det.unbalanced2().freeze(pad_replicas_to=64,
+                                                      pad_brokers_to=8)
+    opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"],
+                        solver=solver_mod.GoalSolver())
+    solve_keys = lambda: {k for k in opt.solver._round_cache
+                          if isinstance(k, tuple) and k and k[0] == "solve"}
+    try:
+        rec.configure(enabled=False)
+        res_off = opt.optimizations(state, placement, meta)
+        off_keys = solve_keys()
+        assert off_keys
+        assert all(p.provenance is None for p in res_off.proposals)
+
+        rec.configure(enabled=True)
+        res_on = opt.optimizations(state, placement, meta)
+    finally:
+        rec.configure(enabled=prev)
+        rec.reset()
+    assert solve_keys() == off_keys         # bitwise identical, zero new keys
+    # Same moves either way; the on-path run stamps lineage onto each.
+    assert ({p.topic_partition for p in res_on.proposals}
+            == {p.topic_partition for p in res_off.proposals})
+    assert res_on.proposals
+    for p in res_on.proposals:
+        assert p.provenance is not None
+        assert p.provenance["path"] in PATHS
+        assert p.provenance["goal"] == "ReplicaDistributionGoal"
+    # ?explain=true rendering: provenance + histogram only when asked.
+    plain = res_on.to_dict()
+    assert "proposals" not in plain and "provenancePaths" not in plain
+    explained = res_on.to_dict(explain=True)
+    hist = explained["provenancePaths"]
+    assert sum(hist.values()) == len(res_on.proposals)
+    assert all(e["provenance"]["path"] in PATHS
+               for e in explained["proposals"])
+
+
+# --------------------------------------------------- flight recorder units
+
+
+def _task(i, old, new):
+    return ExecutionTask(proposal("T", i, old, new),
+                         TaskType.INTER_BROKER_REPLICA_ACTION)
+
+
+def test_recorder_ewma_and_eta():
+    rec = ExecutionFlightRecorder(alpha=0.5)
+    tasks = [_task(i, [0, 1], [2, 1]) for i in range(4)]
+    rec.begin_batch(tasks, principal="admin", request_id="req-1")
+    assert rec.seconds_per_move() == 0.0    # no completions yet
+
+    def complete(task, at_ms):
+        rec.on_transition(task, ExecutionTaskState.IN_PROGRESS, at_ms)
+        task.transition(ExecutionTaskState.IN_PROGRESS, at_ms)
+        rec.on_transition(task, ExecutionTaskState.COMPLETED, at_ms)
+        task.transition(ExecutionTaskState.COMPLETED, at_ms)
+
+    complete(tasks[0], 1000.0)
+    assert rec.seconds_per_move() == 0.0    # one completion: no dt yet
+    complete(tasks[1], 2000.0)              # dt=1.0s seeds the EWMA
+    assert rec.seconds_per_move() == pytest.approx(1.0)
+    complete(tasks[2], 2500.0)              # dt=0.5: 0.5*0.5 + 0.5*1.0
+    assert rec.seconds_per_move() == pytest.approx(0.75)
+    assert rec.moves_per_second() == pytest.approx(1 / 0.75)
+    assert rec.eta_seconds() == pytest.approx(1 * 0.75)   # 1 move left
+    prog = rec.progress()
+    assert prog["active"] and prog["throughput"]["completed"] == 3
+    assert prog["throughput"]["etaSeconds"] == pytest.approx(0.75, abs=0.01)
+    assert prog["batch"]["principal"] == "admin"
+    assert prog["batch"]["requestId"] == "req-1"
+
+    summary = rec.end_batch(completed=3, dead=0, aborted=1, moved_mb=1.5)
+    assert summary["completed"] == 3 and summary["aborted"] == 1
+    assert summary["pathHistogram"] == {"unknown": 4}   # nothing stamped
+    # Idle again: every throughput read returns 0 (SLO never burns idle).
+    assert rec.seconds_per_move() == 0.0
+    assert rec.eta_seconds() == 0.0
+    assert rec.inflight_moves() == 0
+    assert rec.drain() == [summary]
+    assert rec.drain() == []                # drained once
+    assert rec.state_summary()["lastBatch"] == summary
+
+
+def test_recorder_inflight_per_broker():
+    rec = ExecutionFlightRecorder()
+    t1, t2 = _task(0, [0, 1], [2, 1]), _task(1, [0, 3], [3, 0])
+    rec.begin_batch([t1, t2])
+    rec.on_transition(t1, ExecutionTaskState.IN_PROGRESS, 0.0)
+    t1.transition(ExecutionTaskState.IN_PROGRESS, 0.0)
+    rec.on_transition(t2, ExecutionTaskState.IN_PROGRESS, 0.0)
+    t2.transition(ExecutionTaskState.IN_PROGRESS, 0.0)
+    assert rec.inflight_moves() == 2
+    # t1 involves brokers {0,1,2}, t2 {0,3}: broker 0 counts both.
+    assert rec.progress()["inflightPerBroker"] == {
+        "0": 2, "1": 1, "2": 1, "3": 1}
+    rec.on_transition(t1, ExecutionTaskState.COMPLETED, 1.0)
+    t1.transition(ExecutionTaskState.COMPLETED, 1.0)
+    assert rec.progress()["inflightPerBroker"] == {"0": 1, "3": 1}
+    rec.reset()
+
+
+def test_recorder_tuner_events_and_disabled_noop():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ExecutionFlightRecorder()
+    rec.begin_batch([_task(0, [0, 1], [2, 1])])
+    base = registry().counter("Executor.tuner-decreases").count
+    rec.record_tuner("decrease", "task-dead", cap=2)
+    rec.record_tuner("increase", "batch-drained", cap=3)
+    assert registry().counter("Executor.tuner-decreases").count == base + 1
+    prog = rec.progress()
+    assert prog["batch"]["tunerDecreases"] == 1
+    assert prog["batch"]["tunerIncreases"] == 1
+    assert [e["signal"] for e in prog["tunerEvents"]] == [
+        "task-dead", "batch-drained"]
+    assert prog["tunerEvents"][0]["cap"] == 2
+    rec.reset()
+
+    off = ExecutionFlightRecorder(enabled=False)
+    off.begin_batch([_task(0, [0, 1], [2, 1])])
+    off.on_transition(_task(1, [0, 1], [2, 1]),
+                      ExecutionTaskState.IN_PROGRESS, 0.0)
+    assert off.end_batch(1, 0, 0, 0.0) is None
+    assert off.progress() == {"enabled": False, "active": False,
+                              "tunerEvents": [], "recentBatches": []}
+
+
+def test_path_histogram_counts_unknown():
+    p1 = proposal("T", 0, [0, 1], [2, 1])
+    p2 = proposal("T", 1, [0, 1], [3, 1])
+    object.__setattr__(p2, "provenance", {"path": "relax", "goal": "G"})
+    assert path_histogram([p1, p2]) == {"unknown": 1, "relax": 1}
+
+
+# ------------------------------------- joined view during a storm execution
+
+
+def test_execution_progress_joined_during_storm_execution():
+    """Acceptance: GET /execution_progress returns joined provenance + live
+    progress + ETA while a storm-runner execution is in flight."""
+    from cruise_control_tpu.fuzzsvc.scenario import generate_scenario
+    from cruise_control_tpu.fuzzsvc.storm import _wait_idle, build_storm_stack
+
+    rec = execution()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    rec.reset()
+    sc = generate_scenario(3146, kind="exp_skew")
+    # Slow each task down (25 backend polls) and pin per-broker concurrency
+    # to 1 so the batch drains in many small waves — the poll loop below is
+    # guaranteed mid-flight snapshots.
+    stack = build_storm_stack(sc, num_brokers=6, partitions=16, rf=2,
+                              polls_to_finish=25)
+    stack.cc.executor.adjuster.current = 1
+    stack.cc.executor.adjuster.max_concurrency = 1
+    stack.cc.executor.config.concurrent_leader_movements = 1
+    try:
+        res = stack.cc.rebalance(dryrun=False)
+        assert res.executed
+        live, with_eta = None, None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            prog = rec.progress()
+            if prog["active"]:
+                live = prog
+                tp = prog["throughput"]
+                if tp["etaSeconds"] is not None and tp["remaining"] > 0:
+                    with_eta = prog
+                    break
+            elif live is not None:
+                break                       # batch ended after we saw it live
+            time.sleep(0.001)
+        assert live is not None, "never observed the batch in flight"
+        assert live["batch"]["total"] == len(live["tasks"])
+        hist = live["batch"]["pathHistogram"]
+        assert sum(hist.values()) == live["batch"]["total"]
+        for t in live["tasks"]:
+            assert t["provenance"] is not None          # joined lineage
+            assert t["provenance"]["path"] in PATHS
+            assert t["state"] in ("pending", "in_progress", "completed",
+                                  "aborting", "aborted", "dead")
+        if with_eta is not None:            # ≥2 completions observed live
+            tp = with_eta["throughput"]
+            assert tp["secondsPerMove"] > 0
+            assert tp["etaSeconds"] == pytest.approx(
+                tp["remaining"] * tp["secondsPerMove"], rel=0.01)
+        assert _wait_idle(stack.cc, timeout_s=60.0)
+        batches = rec.drain()
+        assert batches, "no batch summary recorded"
+        last = batches[-1]
+        assert last["moves"] == live["batch"]["total"]
+        assert last["completed"] + last["dead"] + last["aborted"] > 0
+        assert sum(last["pathHistogram"].values()) == last["moves"]
+        # The servlet view is this same payload.
+        assert rec.state_summary()["lastBatch"]["executionId"] \
+            == live["batch"]["executionId"]
+    finally:
+        stack.cc.anomaly_detector.shutdown()
+        rec.configure(enabled=prev)
+        rec.reset()

@@ -191,3 +191,47 @@ def test_throttles_set_and_cleared():
     assert seen["rate"] == 1_000_000
     assert seen["brokers"] == [0, 1, 2]       # old ∪ new replicas
     assert backend.throttle_rate is None      # cleared after execution
+
+
+def _action_gauge_values():
+    from cruise_control_tpu.common.metrics import registry
+    snap = registry().snapshot()
+    return {name: rec.get("value") for name, rec in snap.items()
+            if name.startswith(("Executor.replica-action-",
+                                "Executor.leadership-action-"))}
+
+
+def test_action_gauges_zero_after_completed_execution():
+    """Stale-gauge guard: the per-state action gauges report the live batch
+    only, so a finished execution leaves every one of them at zero."""
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=2)
+    ex = Executor(backend, ExecutorConfig(progress_check_interval_s=0.001))
+    ex.execute_proposals([
+        proposal("T", 0, [0, 1], [2, 1]),
+        proposal("T", 2, [2, 3], [3, 2]),       # leadership only
+    ], wait=True)
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    vals = _action_gauge_values()
+    assert len(vals) == 10                      # 2 kinds x 5 states
+    assert all(v == 0 for v in vals.values()), vals
+
+
+def test_action_gauges_zero_after_aborted_execution():
+    """Aborted/dead tasks stay in the lifetime-cumulative tracker; the
+    gauges must not keep exporting them after the batch ends."""
+    md = _metadata()
+    backend = FakeClusterBackend(md, polls_to_finish=1000)
+    cfg = ExecutorConfig(progress_check_interval_s=0.001,
+                         concurrent_partition_movements_per_broker=1)
+    ex = Executor(backend, cfg)
+    props = [proposal("T", i, [0, 1], [2 + (i % 2), 1]) for i in range(4)]
+    ex.execute_proposals(props, wait=False)
+    import time
+    time.sleep(0.05)
+    ex.user_triggered_stop_execution()
+    ex._thread.join(timeout=5)
+    s = ex.tracker.summary()["inter_broker_replica"]
+    assert s.get("aborted", 0) + s.get("dead", 0) >= 1   # tracker keeps them
+    vals = _action_gauge_values()
+    assert all(v == 0 for v in vals.values()), vals       # gauges don't
