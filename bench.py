@@ -343,6 +343,26 @@ def _compile_fields(fresh: int) -> dict:
             "compile_cache": "cold" if fresh > 0 else "warm"}
 
 
+def _fingerprint_fields() -> dict:
+    """Model-fidelity columns for rows whose model is fed by a monitored
+    ingest: the current fingerprint's valid-partition ratio and total
+    extrapolated fraction, plus the mean ingest→window-commit latency.
+    Rows solved from bare fixture snapshots (nothing feeding the fidelity
+    recorder) honestly report None/0."""
+    from cruise_control_tpu.common.metrics import registry
+    from cruise_control_tpu.obsvc.fidelity import fidelity
+    fp = fidelity().current_fingerprint()
+    stats = registry().timer("Monitor.ingest-commit-latency-ms").stats()
+    return {
+        "valid_ratio": (round(fp["validPartitionRatio"], 4)
+                        if fp is not None else None),
+        "extrapolated_fraction": (
+            round(sum(fp["extrapolatedFraction"].values()), 4)
+            if fp is not None else None),
+        "ingest_ms": round(stats["mean_ms"], 3),
+    }
+
+
 def _timed_once(fn):
     """Time ONE call (compile included when it happens).  Returns
     ``(seconds, result, fresh_compiles)`` — the compile count is the
@@ -888,6 +908,37 @@ def _delta_propose_rows(backend: str, props=None, lanes: int = 16,
     rng = np.random.default_rng(314159)
     pairs = list(builder.partitions().keys())
 
+    # Fidelity sidecar (untimed): a small aggregator fed once per steady
+    # round — the production cadence of monitor samples arriving between
+    # delta proposes — so the steady row's fingerprint columns
+    # (valid_ratio / extrapolated_fraction / ingest_ms) are measurements
+    # of a live ingest→fingerprint pipeline, not hardcoded constants.
+    from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+    from cruise_control_tpu.monitor.metric_def import COMMON_METRIC_DEF
+    from cruise_control_tpu.obsvc.fidelity import fidelity
+    fid = fidelity()
+    fid_window_ms = 500
+    fid_agg = MetricSampleAggregator(
+        COMMON_METRIC_DEF, num_windows=8, window_ms=fid_window_ms,
+        min_samples_per_window=1,
+        max_allowed_extrapolations_per_entity=64)
+    fid_pairs = pairs[:64]
+    fid_vals = np.ones(COMMON_METRIC_DEF.size)
+
+    def ingest_fidelity() -> None:
+        now_ms = time.time() * 1000.0
+        before_w = fid_agg.current_window
+        for fp_pair in fid_pairs:
+            fid_agg.add_sample(fp_pair, now_ms, fid_vals)
+        after_w = fid_agg.current_window
+        if before_w >= 0:
+            for w in range(max(before_w, after_w - 9), after_w):
+                fid.on_window_close(w, fid_window_ms, now_ms=now_ms)
+        comp = fid_agg.completeness(0, now_ms)
+        if comp.valid_windows:
+            fid.record_fingerprint(comp, window_ms=fid_window_ms,
+                                   kind="delta", now_ms=now_ms)
+
     def mutate() -> None:
         # Small multiplicative load drift on whole partitions: the shape of
         # a real inter-window change, and it keeps hard goals satisfiable.
@@ -914,6 +965,7 @@ def _delta_propose_rows(backend: str, props=None, lanes: int = 16,
     steady, fresh_total, res = [], 0, base_res
     for _ in range(rounds):
         mutate()
+        ingest_fidelity()
         dt, res, fresh = _timed_once(propose)
         steady.append(dt)
         fresh_total += fresh
@@ -935,6 +987,7 @@ def _delta_propose_rows(backend: str, props=None, lanes: int = 16,
               freeze_transfer_ms / max(da_mean, 1e-6), 1),
           full_freezes_steady_state=full_steady,
           delta_applies=int(delta_ctr.count - delta0),
+          **_fingerprint_fields(),
           **_quality(res), **_compile_fields(fresh_total))
 
     # Lane pair on the SAME resident tensors: raw-snapshot seed first, then

@@ -40,6 +40,7 @@ from cruise_control_tpu.compilesvc.telemetry import telemetry as _compile_teleme
 from cruise_control_tpu.obsvc import convergence as _convergence
 from cruise_control_tpu.obsvc.execution import execution as _execution
 from cruise_control_tpu.obsvc.execution import path_histogram as _path_histogram
+from cruise_control_tpu.obsvc.fidelity import fidelity as _fidelity
 from cruise_control_tpu.obsvc.tracer import tracer as _obsvc_tracer
 from cruise_control_tpu.model.state import ClusterMeta, ClusterState, Placement
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
@@ -106,6 +107,10 @@ class OptimizerResult:
     # status is in goal_infos[i].preempted.
     partial: bool = False
     preempt_reason: Optional[str] = None
+    # Model-fidelity fingerprint of the snapshot this result was solved
+    # from (fidelity observatory; None when the recorder is off).  Stamped
+    # after the solve, never part of the proposal cache key.
+    fingerprint: Optional[Dict] = None
 
     @property
     def summary(self) -> ProposalSummary:
@@ -143,9 +148,11 @@ class OptimizerResult:
         }
         if explain:
             # ?explain=true: per-proposal provenance (goal / path / solve
-            # round / cost delta) plus the path histogram rollup.
+            # round / cost delta) plus the path histogram rollup and the
+            # model-fidelity fingerprint the solve was decided on.
             d["proposals"] = [p.to_dict(explain=True) for p in self.proposals]
             d["provenancePaths"] = _path_histogram(self.proposals)
+            d["modelFingerprint"] = self.fingerprint
         return d
 
 
@@ -633,6 +640,19 @@ class GoalOptimizer:
             preempt_reason=preempt_reason if partial else None,
         )
         proposal_timer.update_ms(result.elapsed_s * 1000.0)
+        # Fidelity observatory: stamp the solve-time model fingerprint onto
+        # the result and every proposal (host dicts, compare=False fields —
+        # never part of the proposal cache key or any executable input, so
+        # the solve is byte-identical with the recorder off).  Stamped
+        # before the cache write so a cached result keeps the fingerprint
+        # of the model it was actually solved from.
+        fid = _fidelity()
+        if fid.enabled:
+            fp = fid.current_fingerprint()
+            if fp is not None:
+                result.fingerprint = fp
+                for p in proposals:
+                    object.__setattr__(p, "fingerprint", fp)
         registry().settable_gauge("AnomalyDetector.balancedness-score").set(
             result.balancedness_score)
         # Partial results are never cached: a later request with more budget

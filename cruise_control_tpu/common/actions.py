@@ -100,6 +100,11 @@ class ExecutionProposal:
     # move the same replicas the same way are the same proposal regardless
     # of which solve produced them.
     provenance: Optional[dict] = field(default=None, compare=False)
+    # Model-fidelity fingerprint (fidelity observatory): the quality of the
+    # monitor snapshot this proposal was solved from, stamped by the
+    # optimizer when the recorder is on.  Excluded from eq/hash for the
+    # same reason as provenance.
+    fingerprint: Optional[dict] = field(default=None, compare=False)
 
     @property
     def new_leader(self) -> ReplicaPlacementInfo:
@@ -147,6 +152,8 @@ class ExecutionProposal:
         }
         if explain and self.provenance is not None:
             d["provenance"] = self.provenance
+        if explain and self.fingerprint is not None:
+            d["modelFingerprint"] = self.fingerprint
         return d
 
 

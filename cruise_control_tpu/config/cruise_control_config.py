@@ -213,6 +213,18 @@ def _anomaly_def() -> ConfigDef:
                  "(restart resumes instead of replaying); empty = "
                  "<transport.dir>/consumer-offsets.json when dir mode, else "
                  "uncommitted")
+    d.define("anomaly.model.min.valid.partition.ratio", ConfigType.DOUBLE,
+             0.0, range_validator(0.0, 1.0),
+             doc="staleness gate: self-healing fixes are IGNORED (audit "
+                 "reason stale_model) while the current model fingerprint's "
+                 "valid-partition ratio is below this; 0.0 disables the "
+                 "ratio check")
+    d.define("anomaly.model.max.age.ms", ConfigType.LONG, 0,
+             range_validator(0),
+             doc="staleness gate: self-healing fixes are IGNORED (audit "
+                 "reason stale_model) while the current model fingerprint's "
+                 "newest valid window is older than this; 0 disables the "
+                 "age check")
     return d
 
 
@@ -380,6 +392,33 @@ def _trace_def() -> ConfigDef:
              range_validator(0.0001, 1.0),
              doc="EWMA smoothing factor for the seconds-per-move estimator "
                  "(higher = reacts faster to the latest completion)")
+    d.define("monitor.fidelity.enabled", ConfigType.BOOLEAN, True,
+             doc="run the model-fidelity observatory: a ModelFingerprint "
+                 "(generation, window age, valid-partition ratio, "
+                 "extrapolated fraction by kind, dead brokers) recorded at "
+                 "every model freeze / resident delta-apply and stamped "
+                 "onto optimizer results and proposals, plus the ingest "
+                 "telemetry ring behind GET /model_quality.  Host-side "
+                 "only: solver executables and jit cache keys are "
+                 "byte-identical with the observatory off")
+    d.define("monitor.fidelity.ring.size", ConfigType.INT, 64,
+             range_validator(1),
+             doc="bounded rings of recent fingerprints, window-close "
+                 "quality entries and liveness flaps the fidelity recorder "
+                 "retains for /model_quality")
+    d.define("slo.model.age.max.ms", ConfigType.DOUBLE, 1_800_000.0,
+             range_validator(0.001),
+             doc="model-freshness objective: the current fingerprint's age "
+                 "(Monitor.fingerprint-age-ms, now minus its newest valid "
+                 "window's end) must stay below this; the gauge reads 0.0 "
+                 "before the first fingerprint so cold boot never burns")
+    d.define("slo.model.valid.partition.ratio.min", ConfigType.DOUBLE, 0.8,
+             range_validator(0.0001, 1.0),
+             doc="model-validity objective: the fingerprint's valid-"
+                 "partition ratio must stay at or above this (evaluated on "
+                 "the inverted Monitor.invalid-partition-ratio gauge, which "
+                 "reads 0.0 before the first fingerprint, so 'bad' is "
+                 "above threshold and idle never burns)")
     return d
 
 

@@ -243,8 +243,17 @@ class Executor:
             # UserTaskManager copies the request context), and from here
             # into the journal batch_start line, the executor.batch span,
             # and the flight recorder's batch record.
+            # Model lineage: the fingerprint the accepted proposals were
+            # solved from (first stamped proposal wins — one batch, one
+            # solve, one model generation).  Rides the journal batch_start
+            # line and the oplog so a crash-recovered batch still knows
+            # what data quality it was decided on.
+            fp = next((f for f in (getattr(t.proposal, "fingerprint", None)
+                                   for t in accepted) if f is not None), None)
             self._batch_meta = {"principal": _oplog.current_principal(),
-                                "requestId": _oplog.current_request_id()}
+                                "requestId": _oplog.current_request_id(),
+                                "modelGeneration":
+                                    fp.get("generation") if fp else None}
             if self.journal is not None:
                 try:
                     self.journal.begin_batch(accepted, meta=self._batch_meta)
@@ -268,7 +277,8 @@ class Executor:
             total, len(proposals), self.config.max_num_cluster_movements)
         _oplog.record("start", endpoint="executor.batch",
                       tasks=total, proposals=len(proposals),
-                      request_id=self._batch_meta["requestId"])
+                      request_id=self._batch_meta["requestId"],
+                      generation=self._batch_meta.get("modelGeneration"))
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="proposal-execution")
         self._thread.start()

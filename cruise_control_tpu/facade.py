@@ -108,6 +108,9 @@ class OperationResult:
     # True when the solve was preempted (deadline / cancel / shutdown / SLO)
     # and returned the best placement found so far instead of converging.
     partial: bool = False
+    # Advisory (never blocks the request): the model fingerprint violated a
+    # configured anomaly.model.* staleness threshold at solve time.
+    model_stale: bool = False
 
     def to_dict(self, explain: bool = False) -> Dict:
         d = {"dryrun": self.dryrun, "executed": self.executed, "info": self.info}
@@ -115,6 +118,8 @@ class OperationResult:
             d["degraded"] = True
         if self.partial:
             d["partial"] = True
+        if self.model_stale:
+            d["modelStale"] = True
         if self.optimizer_result is not None:
             d["result"] = self.optimizer_result.to_dict(explain=explain)
         return d
@@ -756,9 +761,15 @@ class CruiseControl:
                 executed = True
             elif not dryrun:
                 self.executor.set_generating_proposals_for_execution(False)
+            # Advisory staleness tag: the verdict gates self-healing, but
+            # user-requested proposal traffic still serves — flagged so the
+            # caller knows the data quality behind the answer.
+            from cruise_control_tpu.obsvc.fidelity import fidelity as _fidelity
+            stale = _fidelity().staleness_reason() is not None
             return OperationResult(result, dryrun=dryrun, executed=executed,
                                    degraded=degraded,
-                                   partial=bool(result.partial))
+                                   partial=bool(result.partial),
+                                   model_stale=stale)
         except Exception:
             if not dryrun:
                 try:
@@ -1027,6 +1038,32 @@ class CruiseControl:
                           principal="self-healing",
                           anomaly=anomaly.anomaly_type.name)
 
+        # Staleness gate (anomaly.model.* thresholds): never self-heal on a
+        # model the fidelity observatory says is stale or heavily invalid —
+        # a fix computed from bad data can move replicas the wrong way.
+        # SLO-violation anomalies are exempt: preempting a runaway solve
+        # depends on no model data.  User-requested proposal traffic is
+        # unaffected (it serves with an advisory modelStale=true tag).
+        if not isinstance(anomaly, SloViolationAnomaly):
+            from cruise_control_tpu.obsvc.fidelity import fidelity as _fidelity
+            stale = _fidelity().staleness_reason()
+            if stale is not None:
+                _fidelity().record_stale_gate()
+                fp = _fidelity().current_fingerprint()
+                audit_log().record(
+                    anomaly.anomaly_type.name,
+                    {"reason": "stale_model", "detail": stale,
+                     "fingerprint": fp},
+                    "IGNORED")
+                _oplog.record("abort",
+                              endpoint=f"self-healing:"
+                                       f"{anomaly.anomaly_type.name}",
+                              principal="self-healing", reason="stale_model",
+                              generation=(fp or {}).get("generation"))
+                LOG.warning("self-healing fix for %s IGNORED: %s",
+                            anomaly.anomaly_type.name, stale)
+                return False
+
         try:
             if isinstance(anomaly, BrokerFailures):
                 note("remove_broker")
@@ -1109,9 +1146,13 @@ class CruiseControl:
         runner_state = (self.task_runner.state.value
                         if self.task_runner is not None else "NOT_STARTED")
         from cruise_control_tpu.obsvc.execution import execution as _execution
+        from cruise_control_tpu.obsvc.fidelity import fidelity as _fidelity
         from cruise_control_tpu.obsvc.memory import memory_ledger
         return {
-            "MonitorState": self.load_monitor.state(runner_state).to_dict(),
+            "MonitorState": {
+                **self.load_monitor.state(runner_state).to_dict(),
+                "modelQualityState": _fidelity().state_summary(),
+            },
             "ExecutorState": {
                 **self.executor.state_summary(),
                 "executionState": _execution().state_summary(),

@@ -579,6 +579,116 @@ def provenance_complete(m: Materialized) -> List[str]:
     return out
 
 
+def fingerprint_coherent(m: Materialized) -> List[str]:
+    """Model-fidelity accounting is honest on this scenario: a fingerprint
+    condensed from a synthetic aggregation (windows/gaps derived from the
+    scenario seed) must agree with an independent per-entity recount of the
+    aggregator's extrapolation output, and with the fidelity recorder live
+    every proposal the optimizer emits carries exactly one fingerprint
+    whose generation matches the model the solve actually read — no move
+    can reach the executor without a data-quality lineage."""
+    from cruise_control_tpu.monitor.aggregator import (
+        AggregationOptions, MetricSampleAggregator)
+    from cruise_control_tpu.monitor.metric_def import COMMON_METRIC_DEF
+    from cruise_control_tpu.obsvc.fidelity import (
+        EXTRAPOLATION_KINDS, ModelFidelityRecorder, fidelity)
+
+    out: List[str] = []
+    rng = np.random.default_rng(m.scenario.seed ^ 0xF1D0)
+    window_ms, n_windows = 1_000, 6
+    agg = MetricSampleAggregator(COMMON_METRIC_DEF,
+                                 num_windows=n_windows, window_ms=window_ms,
+                                 min_samples_per_window=2,
+                                 max_allowed_extrapolations_per_entity=4)
+    n_metrics = COMMON_METRIC_DEF.size
+    entities = [("t", p) for p in range(8)]
+    for w in range(n_windows + 1):
+        for e in entities:
+            # Seeded gap pattern: each entity-window gets 0..3 samples, so
+            # the corpus exercises every extrapolation kind over time.
+            for _ in range(int(rng.integers(0, 4))):
+                agg.add_sample(e, w * window_ms + 10,
+                               rng.uniform(1.0, 9.0, size=n_metrics))
+    try:
+        result = agg.aggregate(0, (n_windows + 1) * window_ms,
+                               AggregationOptions(min_valid_windows=1))
+    except Exception as exc:  # noqa: BLE001 — degenerate corpus, not a bug
+        return [f"synthetic aggregation raised {type(exc).__name__}: {exc}"]
+    comp = result.completeness
+
+    # Independent recount from the per-entity extrapolation maps (valid
+    # entities only — exactly what values_and_extrapolations holds).
+    recount = {k: 0 for k in EXTRAPOLATION_KINDS}
+    for ve in result.values_and_extrapolations.values():
+        for kind in ve.extrapolations.values():
+            if kind.name in recount:
+                recount[kind.name] += 1
+    counted = {"AVG_AVAILABLE": comp.num_windows_avg_available,
+               "AVG_ADJACENT": comp.num_windows_avg_adjacent,
+               "FORECAST": comp.num_windows_forecast}
+    if recount != counted:
+        out.append(f"completeness by-kind counts {counted} != independent "
+                   f"recount {recount}")
+    want_windows = (len(result.values_and_extrapolations)
+                    * len(comp.valid_windows))
+    if comp.num_entity_windows != want_windows:
+        out.append(f"num_entity_windows {comp.num_entity_windows} != "
+                   f"valid entities x windows {want_windows}")
+
+    rec = ModelFidelityRecorder(enabled=True)
+    fp = rec.record_fingerprint(comp, window_ms=window_ms)
+    if fp is None:
+        return out + ["record_fingerprint returned None while enabled"]
+    if fp["validWindows"] != len(comp.valid_windows):
+        out.append(f"fingerprint validWindows {fp['validWindows']} != "
+                   f"{len(comp.valid_windows)}")
+    if abs(fp["validPartitionRatio"] - comp.valid_entity_ratio) > 1e-6:
+        out.append(f"fingerprint ratio {fp['validPartitionRatio']} != "
+                   f"completeness {comp.valid_entity_ratio}")
+    denom = max(comp.num_entity_windows, 1)
+    for kind in EXTRAPOLATION_KINDS:
+        want = recount[kind] / denom
+        got = fp["extrapolatedFraction"][kind]
+        if abs(got - want) > 1e-6:
+            out.append(f"extrapolatedFraction[{kind}] {got} != recounted "
+                       f"{want:.6f}")
+    if fp["generation"] != agg.generation:
+        out.append(f"fingerprint generation {fp['generation']} != aggregator "
+                   f"generation {agg.generation}")
+
+    # Solve with the recorder live: every proposal carries exactly the
+    # fingerprint of the model generation the solve read.  One goal from
+    # the shared smoke stack is enough — stamping happens at the result
+    # level, so goal count adds cost, not coverage (the distribution goal
+    # is the one that reliably emits moves on fuzzed skew).
+    live = fidelity()
+    prev_enabled, prev_fp = live.enabled, live._fingerprint
+    live.configure(enabled=True)
+    live._fingerprint = fp
+    try:
+        stamp_goals = [g for g in m.scenario.goal_names
+                       if g == "ReplicaDistributionGoal"] \
+            or list(m.scenario.goal_names)[:1]
+        res = GoalOptimizer(goal_names=stamp_goals
+                            ).optimizations(m.state, m.placement, m.meta)
+    finally:
+        live.configure(enabled=prev_enabled)
+        live._fingerprint = prev_fp
+    if res.fingerprint is None:
+        out.append("result carries no fingerprint with the recorder live")
+    elif res.fingerprint["generation"] != fp["generation"]:
+        out.append(f"result fingerprint generation "
+                   f"{res.fingerprint['generation']} != {fp['generation']}")
+    for p in res.proposals:
+        pfp = getattr(p, "fingerprint", None)
+        if pfp is None:
+            out.append(f"{p.topic_partition}: move without a fingerprint")
+        elif pfp["generation"] != fp["generation"]:
+            out.append(f"{p.topic_partition}: fingerprint generation "
+                       f"{pfp['generation']} != {fp['generation']}")
+    return out
+
+
 INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "hard_goals_never_worsen": hard_goals_never_worsen,
     "soft_goals_no_regression": soft_goals_no_regression,
@@ -590,6 +700,7 @@ INVARIANTS: Dict[str, Callable[[Materialized], List[str]]] = {
     "relaxation_sound": relaxation_sound,
     "memory_ledger_balanced": memory_ledger_balanced,
     "provenance_complete": provenance_complete,
+    "fingerprint_coherent": fingerprint_coherent,
     "stranded_cleared": stranded_cleared,
     "mesh_parity": mesh_parity,
     "chunked_parity": chunked_parity,

@@ -52,7 +52,7 @@ BASE_INVARIANTS: Tuple[str, ...] = (
     "proposals_executable", "load_conservation",
     "resident_delta_equivalence", "convergence_curve_coherent",
     "partial_solve_safe", "relaxation_sound", "memory_ledger_balanced",
-    "provenance_complete",
+    "provenance_complete", "fingerprint_coherent",
 )
 
 # Shared padded shapes for the smoke profile (see module docstring).

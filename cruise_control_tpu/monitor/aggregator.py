@@ -22,6 +22,7 @@ hot path SURVEY.md §3.3 flags (O(replicas × windows × metrics)).
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Hashable, List, Optional, Sequence, Tuple
@@ -30,6 +31,8 @@ import numpy as np
 
 from cruise_control_tpu.common.exceptions import NotEnoughValidWindowsError
 from cruise_control_tpu.monitor.metric_def import MetricDef, ValueComputingStrategy
+
+LOG = logging.getLogger(__name__)
 
 
 class Extrapolation(enum.Enum):
@@ -67,6 +70,14 @@ class MetricSampleCompleteness:
     # Valid entities that needed extrapolation for at least one window
     # (Sensors.md num-partitions-with-extrapolations).
     num_valid_entities_with_extrapolations: int = 0
+    # Fidelity-fingerprint accounting over VALID entities only (the windows
+    # that actually enter a model): total entity-windows considered and the
+    # extrapolated ones by kind.  Defaulted so bare construction on the
+    # not-enough-windows fallback path stays valid.
+    num_entity_windows: int = 0
+    num_windows_avg_available: int = 0
+    num_windows_avg_adjacent: int = 0
+    num_windows_forecast: int = 0
 
 
 @dataclass
@@ -128,6 +139,13 @@ class MetricSampleAggregator:
     def generation(self) -> int:
         return self._generation
 
+    @property
+    def current_window(self) -> int:
+        """Absolute index of the active window; -1 before the first sample.
+        Callers (the task runner's window-close detector) compare this
+        across an ingest to see which windows just committed."""
+        return self._current_window
+
     def _ensure_entity(self, entity: Hashable) -> int:
         idx = self._entity_index.get(entity)
         if idx is None:
@@ -184,18 +202,33 @@ class MetricSampleAggregator:
         with self._lock:
             windows = (np.asarray(times_ms, dtype=np.int64) // self.window_ms)
             first_ingest = self._current_window < 0
+            # Windows strictly below the PRE-roll active window were already
+            # closed when this batch arrived — out-of-order arrivals that
+            # would otherwise scatter into committed (or recycled) window
+            # buffers.  Dropped with a counter + debug log; a batch spanning
+            # several windows (including the one it advances past) is fine.
+            closed_before = self._current_window
             newest = int(windows.max(initial=self._current_window))
             if newest > self._current_window:
                 self._roll_to(newest)
             oldest_kept = self._current_window - self.num_windows
             ok = windows >= max(oldest_kept, 0)
+            if not first_ingest:
+                late = windows < closed_before
+                if late.any():
+                    n_late = int(late.sum())
+                    LOG.debug(
+                        "dropping %d out-of-order sample(s) for already-"
+                        "closed windows (< %d)", n_late, closed_before)
+                    from cruise_control_tpu.obsvc.fidelity import fidelity
+                    fidelity().on_dropped("out_of_order", n_late)
+                    ok &= ~late
             if not ok.any():
                 return 0
-            # Track the oldest window that ever ACCEPTED a sample: backfill
-            # within the retained ring (windows older than the batch that
-            # created the ring) must widen the observed range, and a batched
+            # Track the oldest window that ever ACCEPTED a sample: a batched
             # first ingest must count from its oldest window, not the newest
-            # one _roll_to saw.
+            # one _roll_to saw (later batches can no longer backfill closed
+            # windows — the out-of-order drop above rejects them).
             accepted_oldest = int(windows[ok].min())
             if first_ingest or accepted_oldest < self._first_window:
                 self._first_window = max(accepted_oldest, 0)
@@ -344,6 +377,12 @@ class MetricSampleAggregator:
             num_extrapolated = (some | adjacent | forecast).sum(axis=1)
             entity_valid = (~invalid).all(axis=1) & (
                 num_extrapolated <= self.max_extrapolations)
+            # By-kind extrapolation counts over VALID entities (the windows
+            # that actually enter a model) — fidelity-fingerprint inputs.
+            valid_rows = entity_valid[:, None]
+            n_avg_available = int((some & valid_rows).sum())
+            n_avg_adjacent = int((adjacent & valid_rows).sum())
+            n_forecast = int((forecast & valid_rows).sum())
 
             # --- completeness --------------------------------------------
             groups: Dict[Hashable, bool] = {}
@@ -358,7 +397,11 @@ class MetricSampleAggregator:
                 num_valid_entities=int(entity_valid.sum()),
                 generation=self._generation,
                 num_valid_entities_with_extrapolations=int(
-                    (entity_valid & (num_extrapolated > 0)).sum()))
+                    (entity_valid & (num_extrapolated > 0)).sum()),
+                num_entity_windows=int(entity_valid.sum()) * w_n,
+                num_windows_avg_available=n_avg_available,
+                num_windows_avg_adjacent=n_avg_adjacent,
+                num_windows_forecast=n_forecast)
             if ratio < options.min_valid_entity_ratio:
                 raise NotEnoughValidWindowsError(
                     f"valid entity ratio {ratio:.3f} < "

@@ -104,6 +104,8 @@ class ConsumingMetricSampler:
                 except Exception:
                     LOG.warning("undecodable metric record on partition %d", p,
                                 exc_info=True)
+                    from cruise_control_tpu.obsvc.fidelity import fidelity
+                    fidelity().on_dropped("undecodable")
                     continue
                 if m is not None:
                     # No window filter: offsets only advance once, so late

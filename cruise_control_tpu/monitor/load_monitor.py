@@ -147,6 +147,23 @@ class LoadMonitor:
                                self.metadata_client.cluster().partitions}))
         self._model_timer = reg.timer("LoadMonitor.cluster-model-creation-timer")
 
+    def _record_fingerprint(self, metadata: ClusterMetadata, completeness,
+                            kind: str) -> None:
+        """Fidelity observatory: stamp one ModelFingerprint per model
+        freeze / resident delta-apply (host-side bookkeeping over the
+        completeness output — never touches solver inputs)."""
+        from cruise_control_tpu.obsvc.fidelity import fidelity
+        fid = fidelity()
+        if not fid.enabled:
+            return
+        fid.record_fingerprint(
+            completeness,
+            window_ms=self.partition_aggregator.window_ms,
+            dead_brokers=[b.broker_id for b in metadata.brokers
+                          if not b.alive],
+            capacity_source=type(self.capacity_resolver).__name__,
+            kind=kind)
+
     # ---------------------------------------------------------- generation
 
     @property
@@ -213,6 +230,7 @@ class LoadMonitor:
                 min_valid_windows=requirements.min_required_num_windows,
                 group_granularity=requirements.include_all_topics)
             result = self.partition_aggregator.aggregate(from_ms, to_ms, options)
+            self._record_fingerprint(metadata, result.completeness, "freeze")
             cm = self._populate(metadata, result, allow_capacity_estimation)
             if pad_fn is not None:
                 pad_replicas_to, pad_brokers_to = pad_fn(
@@ -230,6 +248,7 @@ class LoadMonitor:
             min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
             min_valid_windows=requirements.min_required_num_windows)
         result = self.partition_aggregator.aggregate(-float("inf"), to_ms, options)
+        self._record_fingerprint(metadata, result.completeness, "freeze")
         return self._populate(metadata, result,
                               kwargs.get("allow_capacity_estimation", True))
 
@@ -278,6 +297,10 @@ class LoadMonitor:
             min_valid_windows=requirements.min_required_num_windows)
         result = self.partition_aggregator.aggregate(-float("inf"), to_ms, options)
         fp = self._metadata_fingerprint(metadata, allow_capacity_estimation)
+        self._record_fingerprint(
+            metadata, result.completeness,
+            "freeze" if (self._resident_builder is None
+                         or fp != self._resident_fp) else "delta")
         if self._resident_builder is None or fp != self._resident_fp:
             cm = self._populate(metadata, result, allow_capacity_estimation)
             cm.enable_delta_tracking()

@@ -20,6 +20,14 @@ from typing import Callable, Dict, List, Optional, Protocol, Tuple
 from cruise_control_tpu.monitor.samples import BrokerMetricSample, PartitionMetricSample
 
 
+def _count_stored(n: int) -> None:
+    """Ingest telemetry: samples persisted to the store (fidelity
+    observatory `Monitor.stored-samples`, registered eagerly there)."""
+    if n:
+        from cruise_control_tpu.common.metrics import registry
+        registry().counter("Monitor.stored-samples").inc(n)
+
+
 class SampleStore(Protocol):
     def store_samples(self, partition_samples: List[PartitionMetricSample],
                       broker_samples: List[BrokerMetricSample]) -> None: ...
@@ -84,6 +92,7 @@ class FileSampleStore:
             if self._bcount > self._max_records:
                 self._truncate(self._bpath, self._max_records // 2)
                 self._bcount = self._count_lines(self._bpath)
+        _count_stored(len(partition_samples) + len(broker_samples))
 
     @staticmethod
     def _truncate(path: str, keep: int) -> None:
@@ -157,6 +166,7 @@ class LogSampleStore:
         for s in broker_samples:
             self._append(self._bt, 1, s.broker_id % self._bt.num_partitions,
                          json.dumps(s.to_dict()).encode("utf-8"))
+        _count_stored(len(partition_samples) + len(broker_samples))
 
     def _append(self, transport, tid: int, partition: int, record: bytes) -> None:
         transport.append(partition, record)

@@ -99,7 +99,10 @@ class CruiseControlMetricsProcessor:
                     bm.get(RawMetricType.ALL_TOPIC_REPLICATION_BYTES_IN, 0.0),
                     bytes_in, bytes_out)
                 if cpu is None:
-                    continue  # inconsistent sample — dropped, as in reference
+                    # Inconsistent sample — dropped, as in reference.
+                    from cruise_control_tpu.obsvc.fidelity import fidelity
+                    fidelity().on_dropped("inconsistent")
+                    continue
                 ps = PartitionMetricSample(broker_id=broker_id, topic=p.topic,
                                            partition=p.partition)
                 ps.record(md.CPU_USAGE, cpu)

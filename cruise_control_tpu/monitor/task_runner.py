@@ -140,6 +140,14 @@ class LoadMonitorTaskRunner:
                 reg.counter("MetricFetcherManager."
                             "partition-samples-fetcher-failure-rate").inc()
                 raise
+            # Fidelity observatory: per-fetch sample counts + broker-liveness
+            # flap detection from the metadata this tick refreshed.
+            from cruise_control_tpu.obsvc.fidelity import fidelity
+            fid = fidelity()
+            fid.on_fetch(len(result.partition_samples),
+                         len(result.broker_samples))
+            fid.record_liveness({b.broker_id: bool(b.alive)
+                                 for b in metadata.brokers}, now_ms=now_ms)
             n = self._ingest(result)
             self._last_sampling_ms = now_ms
             return n
@@ -153,6 +161,7 @@ class LoadMonitorTaskRunner:
 
         lm = self.load_monitor
         n = 0
+        before = lm.partition_aggregator.current_window
         if result.partition_samples:
             entities = [s.entity for s in result.partition_samples]
             times = np.array([s.time_ms for s in result.partition_samples])
@@ -165,6 +174,16 @@ class LoadMonitorTaskRunner:
             n += lm.broker_aggregator.add_samples(entities, times, metrics)
         self.sample_store.store_samples(result.partition_samples,
                                         result.broker_samples)
+        # Window-close detection: any window the ingest rolled the active
+        # pointer past just committed.  Bounded to the ring span so a clock
+        # jump cannot emit an unbounded event burst.
+        after = lm.partition_aggregator.current_window
+        if before >= 0 and after > before:
+            from cruise_control_tpu.obsvc.fidelity import fidelity
+            window_ms = lm.partition_aggregator.window_ms
+            span = lm.partition_aggregator.num_windows + 1
+            for w in range(max(before, after - span), after):
+                fidelity().on_window_close(w, window_ms)
         return n
 
     # ------------------------------------------------------------ bootstrap

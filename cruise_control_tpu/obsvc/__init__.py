@@ -14,6 +14,10 @@ Public surface:
   throughput/ETA, AIMD tuner events; on by default,
   ``execution.observatory.enabled``; GET /execution_progress,
   ``Executor.*`` throughput sensors).
+* :func:`fidelity` — the model-fidelity recorder (ingest telemetry,
+  per-window quality ring, ModelFingerprint stamping and the staleness
+  verdict; on by default, ``monitor.fidelity.enabled``;
+  GET /model_quality, ``Monitor.*`` sensors).
 * :func:`history` — the sensor history sampler (bounded per-sensor
   time-series rings; on by default, ``obs.history.enabled``).
 * :func:`memory_ledger` — the device-buffer & executable-cost ledgers
@@ -32,6 +36,7 @@ from cruise_control_tpu.obsvc.audit import AuditLog, audit_log
 from cruise_control_tpu.obsvc.convergence import ConvergenceRecorder, convergence
 from cruise_control_tpu.obsvc.execution import (ExecutionFlightRecorder,
                                                 execution)
+from cruise_control_tpu.obsvc.fidelity import ModelFidelityRecorder, fidelity
 from cruise_control_tpu.obsvc.history import HistoryRecorder, history
 from cruise_control_tpu.obsvc.memory import (DeviceMemoryLedger,
                                              ExecutableCostLedger,
@@ -40,9 +45,9 @@ from cruise_control_tpu.obsvc.tracer import Span, Tracer, tracer
 
 __all__ = ["AuditLog", "ConvergenceRecorder", "DeviceMemoryLedger",
            "ExecutableCostLedger", "ExecutionFlightRecorder",
-           "HistoryRecorder", "Span", "Tracer", "audit_log", "configure",
-           "convergence", "cost_ledger", "execution", "history",
-           "memory_ledger", "tracer"]
+           "HistoryRecorder", "ModelFidelityRecorder", "Span", "Tracer",
+           "audit_log", "configure", "convergence", "cost_ledger",
+           "execution", "fidelity", "history", "memory_ledger", "tracer"]
 
 
 def configure(config) -> Tracer:
@@ -72,6 +77,13 @@ def configure(config) -> Tracer:
         enabled=bool(config.get("execution.observatory.enabled")),
         ring_size=int(config.get("execution.history.ring.size")),
         alpha=float(config.get("execution.throughput.ewma.alpha")))
+
+    fidelity().configure(
+        enabled=bool(config.get("monitor.fidelity.enabled")),
+        ring_size=int(config.get("monitor.fidelity.ring.size")),
+        min_valid_partition_ratio=float(
+            config.get("anomaly.model.min.valid.partition.ratio")),
+        max_age_ms=int(config.get("anomaly.model.max.age.ms")))
 
     _memory.configure(config)
 

@@ -127,6 +127,12 @@ class ExecutionFlightRecorder:
         if not self.enabled:
             return
         hist = path_histogram([t.proposal for t in tasks])
+        # The batch's model fingerprint: the first stamped proposal wins —
+        # all tasks of one batch come from one solve (and thus one model
+        # generation); None when the fidelity recorder was off at solve time.
+        fingerprint = next((fp for fp in
+                            (getattr(t.proposal, "fingerprint", None)
+                             for t in tasks) if fp is not None), None)
         with self._lock:
             self._batch = {
                 "executionId": (execution_id if execution_id is not None
@@ -139,6 +145,7 @@ class ExecutionFlightRecorder:
                 "tasks": list(tasks),
                 "tunerIncreases": 0,
                 "tunerDecreases": 0,
+                "fingerprint": fingerprint,
             }
             self._inflight = {}
             self._in_progress = 0
@@ -237,6 +244,8 @@ class ExecutionFlightRecorder:
                 "tunerIncreases": b["tunerIncreases"],
                 "tunerDecreases": b["tunerDecreases"],
             }
+            if b.get("fingerprint") is not None:
+                summary["modelGeneration"] = b["fingerprint"].get("generation")
             self._ring.append(summary)
             self._pending.append(summary)
             self._recorded += 1
@@ -307,6 +316,8 @@ class ExecutionFlightRecorder:
                 "tunerIncreases": b["tunerIncreases"],
                 "tunerDecreases": b["tunerDecreases"],
             }
+            if b.get("fingerprint") is not None:
+                out["batch"]["modelFingerprint"] = b["fingerprint"]
             out["tasks"] = tasks
             out["throughput"] = {
                 "completed": self._completed,

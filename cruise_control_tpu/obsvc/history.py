@@ -109,6 +109,17 @@ class HistoryRecorder:
             ring = self._series[name] = deque(maxlen=self.ring_size)
         ring.append((ts_ms, value))
 
+    def record_event(self, name: str, value: float,
+                     ts_ms: Optional[float] = None) -> None:
+        """Event-driven sample hook: push one point into ``name``'s ring
+        outside the interval sampler, so transitions faster than the
+        sampling interval (e.g. monitor window closes) still land in
+        ``/metrics/history``.  Bounded by the ring's existing cap."""
+        if ts_ms is None:
+            ts_ms = round(self._clock() * 1000.0, 1)
+        with self._lock:
+            self._append(name, float(ts_ms), float(value))
+
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:

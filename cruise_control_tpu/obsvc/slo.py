@@ -49,7 +49,7 @@ class SloObjective:
 
 
 def objectives_from_config(config) -> List[SloObjective]:
-    """The five built-in objectives, thresholds from ``slo.*`` keys."""
+    """The seven built-in objectives, thresholds from ``slo.*`` keys."""
     return [
         SloObjective(
             name="memory-headroom",
@@ -74,6 +74,20 @@ def objectives_from_config(config) -> List[SloObjective]:
             name="execution-throughput",
             pattern="Executor.seconds-per-move",
             threshold=float(config.get("slo.execution.seconds.per.move.max"))),
+        SloObjective(
+            # Model freshness: age of the fidelity fingerprint's newest
+            # valid window.  The gauge reads 0.0 before the first
+            # fingerprint, so cold boot never burns.
+            name="model-freshness",
+            pattern="Monitor.fingerprint-age-ms",
+            threshold=float(config.get("slo.model.age.max.ms"))),
+        SloObjective(
+            # Model validity, inverted so "bad" is ABOVE threshold: the
+            # gauge is 1 - valid-partition-ratio (0.0 with no fingerprint).
+            name="model-validity",
+            pattern="Monitor.invalid-partition-ratio",
+            threshold=1.0 - float(
+                config.get("slo.model.valid.partition.ratio.min"))),
     ]
 
 

@@ -86,6 +86,15 @@ ENDPOINT_INFO: Dict[str, Tuple[str, List[Tuple[str, str, str]], str]] = {
                            "tuner events; 404 while "
                            "execution.observatory.enabled=false", [],
                            "VIEWER"),
+    "model_quality": ("Fidelity observatory: the current model fingerprint "
+                      "(generation, newest-valid-window age, valid-partition "
+                      "ratio, per-kind extrapolated fractions, dead brokers, "
+                      "capacity source) with its staleness verdict against "
+                      "the anomaly.model.* thresholds, the per-window "
+                      "quality ring (ingest→commit latency per close), "
+                      "broker-liveness flaps and the last fetch summary; "
+                      "404 while monitor.fidelity.enabled=false", [],
+                      "VIEWER"),
     "memory": ("Device-memory observatory: per-subsystem live-bytes ledger, "
                "backend reconciliation, headroom-guard shrink/refusal "
                "counters, and per-executable compile-cost rows "
@@ -277,6 +286,12 @@ def build_spec() -> Dict:
             responses["404"] = {
                 "description": "execution observatory disabled "
                                "(execution.observatory.enabled=false)",
+                "content": {"application/json": {"schema":
+                            {"$ref": "#/components/schemas/Error"}}}}
+        if endpoint == "model_quality":
+            responses["404"] = {
+                "description": "fidelity observatory disabled "
+                               "(monitor.fidelity.enabled=false)",
                 "content": {"application/json": {"schema":
                             {"$ref": "#/components/schemas/Error"}}}}
         if endpoint == "profile":

@@ -504,6 +504,82 @@ EXECUTION_PROGRESS_SCHEMA = {
     },
 }
 
+_FINGERPRINT_SCHEMA = {
+    # Model fingerprint: the quality of the monitor snapshot a solve (or
+    # the current moment) sees — stamped onto proposals at solve time.
+    "type": ["object", "null"],
+    "properties": {
+        "generation": {"type": "integer"},
+        "windowEndMs": {"type": ["number", "null"]},
+        "ageMs": {"type": ["number", "null"]},
+        "validWindows": {"type": "integer"},
+        "validPartitionRatio": {"type": "number"},
+        "extrapolatedFraction": {
+            "type": "object",
+            "properties": {k: {"type": "number"}
+                           for k in ("AVG_AVAILABLE", "AVG_ADJACENT",
+                                     "FORECAST")},
+        },
+        "deadBrokers": {"type": "array", "items": {"type": "integer"}},
+        "capacitySource": {"type": "string"},
+        "kind": {"type": "string", "enum": ["freeze", "delta"]},
+        "frozenAtMs": {"type": "number"},
+    },
+}
+
+MODEL_QUALITY_SCHEMA = {
+    "type": "object",
+    "required": ["enabled", "fingerprint", "stale", "thresholds",
+                 "windowQuality"],
+    "properties": {
+        "enabled": {"type": "boolean"},
+        "fingerprint": _FINGERPRINT_SCHEMA,
+        "stale": {"type": ["string", "null"]},
+        "thresholds": {
+            "type": "object",
+            "properties": {
+                "minValidPartitionRatio": {"type": "number"},
+                "maxAgeMs": {"type": "integer"},
+            },
+        },
+        "windowQuality": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["window", "windowEndMs", "closedAtMs",
+                             "ingestCommitMs"],
+                "properties": {
+                    "window": {"type": "integer"},
+                    "windowEndMs": {"type": "number"},
+                    "closedAtMs": {"type": "number"},
+                    "ingestCommitMs": {"type": "number"},
+                },
+            },
+        },
+        "recentFingerprints": {"type": "array",
+                               "items": _FINGERPRINT_SCHEMA},
+        "livenessFlaps": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "broker": {"type": "integer"},
+                    "alive": {"type": "boolean"},
+                    "atMs": {"type": "number"},
+                },
+            },
+        },
+        "lastFetch": {
+            "type": "object",
+            "properties": {
+                "partitionSamples": {"type": "integer"},
+                "brokerSamples": {"type": "integer"},
+                "atMs": {"type": ["number", "null"]},
+            },
+        },
+    },
+}
+
 _HEALTH_PROBE_SCHEMA = {
     "type": "object",
     "required": ["status"],
@@ -559,5 +635,6 @@ ENDPOINT_SCHEMAS: Dict[str, Dict] = {
     "profile": PROFILE_SCHEMA,
     "memory": MEMORY_SCHEMA,
     "execution_progress": EXECUTION_PROGRESS_SCHEMA,
+    "model_quality": MODEL_QUALITY_SCHEMA,
     "health": HEALTH_SCHEMA,
 }

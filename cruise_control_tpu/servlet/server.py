@@ -51,7 +51,7 @@ GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
                  "metrics", "compile_cache", "trace", "health",
                  "solver_stats", "metrics/history", "memory", "profile",
-                 "execution_progress"}
+                 "execution_progress", "model_quality"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -487,6 +487,18 @@ class CruiseControlApp:
             return 404, {"error": "memory ledger disabled "
                                   "(memory.enabled=false)"}, {}
         return 200, ledger.snapshot(), {}
+
+    def _ep_model_quality(self, params, task_id):
+        """Fidelity observatory: the current model fingerprint with its
+        staleness verdict, the per-window quality ring, broker-liveness
+        flaps and the last fetch summary (404 while
+        monitor.fidelity.enabled=false)."""
+        from cruise_control_tpu.obsvc.fidelity import fidelity
+        rec = fidelity()
+        if not rec.enabled:
+            return 404, {"error": "fidelity observatory disabled "
+                                  "(monitor.fidelity.enabled=false)"}, {}
+        return 200, rec.quality(), {}
 
     def _ep_execution_progress(self, params, task_id):
         """Execution observatory: the active batch's per-task state joined

@@ -151,6 +151,12 @@ def collect_live(timeout_s: float = 90.0):
         status, _, _ = get("/execution_progress")
         if status != 200:
             raise RuntimeError(f"/execution_progress not serving: {status}")
+        # And the fidelity observatory (default-on): the boot above has the
+        # sampler live, so /model_quality must serve window-quality rings
+        # and a fingerprint from the /proposals solves.
+        status, _, _ = get("/model_quality")
+        if status != 200:
+            raise RuntimeError(f"/model_quality not serving: {status}")
         _, body, _ = get("/metrics?json=true")
         _, text, _ = get("/metrics")
         return json.loads(body)["sensors"], text
@@ -170,6 +176,12 @@ def collect_live(timeout_s: float = 90.0):
         # leave it enabled (that IS the default state).
         from cruise_control_tpu.obsvc.execution import execution
         execution().reset()
+        # Likewise the fidelity recorder (default ON, thresholds default
+        # disabled): drop the boot's fingerprints and rings.
+        from cruise_control_tpu.obsvc.fidelity import fidelity
+        fidelity().reset()
+        fidelity().configure(enabled=True, min_valid_partition_ratio=0.0,
+                             max_age_ms=0)
 
 
 def main() -> int:

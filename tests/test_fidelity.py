@@ -1,0 +1,491 @@
+"""Model-fidelity observatory tests (docs/MONITORING.md).
+
+Covers the off-path guarantee (solver jit-cache keys bitwise identical with
+the recorder on or off), the fingerprint stamping + explain rendering, the
+staleness verdict strings and their disabled-by-default thresholds, the
+self-healing staleness gate (IGNORED `stale_model` audit entry, fix never
+starts, propose traffic serves with an advisory `modelStale` tag), and
+`GET /model_quality` serving over HTTP during a storm-runner execution.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from cruise_control_tpu.obsvc.fidelity import (
+    EXTRAPOLATION_KINDS,
+    ModelFidelityRecorder,
+    fidelity,
+)
+
+
+def _completeness(generation=7, valid_windows=(0, 1, 2, 3, 4),
+                  num_entities=10, avg_available=3, avg_adjacent=1,
+                  forecast=1, valid_ratio=0.5):
+    """A synthetic MetricSampleCompleteness: record_fingerprint reads it
+    through getattr, so a namespace with the right fields is enough."""
+    return SimpleNamespace(
+        generation=generation,
+        valid_windows=list(valid_windows),
+        num_entity_windows=num_entities * len(valid_windows),
+        num_windows_avg_available=avg_available,
+        num_windows_avg_adjacent=avg_adjacent,
+        num_windows_forecast=forecast,
+        valid_entity_ratio=valid_ratio,
+    )
+
+
+# ------------------------------------------------------- off-path guarantee
+
+
+def test_fidelity_off_path_cache_keys_bitwise_identical():
+    """Acceptance: the recorder is host-side bookkeeping over materialized
+    completeness output — flipping it on compiles NOTHING new and perturbs
+    NO existing jit-cache key; it only stamps host dicts onto results."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import solver as solver_mod
+    from cruise_control_tpu.testing import deterministic as det
+
+    rec = fidelity()
+    prev = (rec.enabled, rec.min_valid_partition_ratio, rec.max_age_ms)
+    state, placement, meta = det.unbalanced2().freeze(pad_replicas_to=64,
+                                                      pad_brokers_to=8)
+    opt = GoalOptimizer(goal_names=["ReplicaDistributionGoal"],
+                        solver=solver_mod.GoalSolver())
+    solve_keys = lambda: {k for k in opt.solver._round_cache
+                          if isinstance(k, tuple) and k and k[0] == "solve"}
+    try:
+        rec.configure(enabled=False)
+        res_off = opt.optimizations(state, placement, meta)
+        off_keys = solve_keys()
+        assert off_keys
+        assert res_off.fingerprint is None
+        assert all(p.fingerprint is None for p in res_off.proposals)
+
+        rec.configure(enabled=True)
+        fp = rec.record_fingerprint(_completeness(generation=42),
+                                    window_ms=1000)
+        assert fp is not None
+        res_on = opt.optimizations(state, placement, meta)
+    finally:
+        rec.configure(enabled=prev[0], min_valid_partition_ratio=prev[1],
+                      max_age_ms=prev[2])
+        rec.reset()
+    assert solve_keys() == off_keys         # bitwise identical, zero new keys
+    # Same moves either way; the on-path run stamps data-quality lineage.
+    assert ({p.topic_partition for p in res_on.proposals}
+            == {p.topic_partition for p in res_off.proposals})
+    assert res_on.proposals
+    assert res_on.fingerprint is not None
+    assert res_on.fingerprint["generation"] == 42
+    for p in res_on.proposals:
+        assert p.fingerprint is not None
+        assert p.fingerprint["generation"] == 42
+    # ?explain=true rendering: fingerprint on the result and each proposal,
+    # absent from the plain render.
+    plain = res_on.to_dict()
+    assert "modelFingerprint" not in plain and "proposals" not in plain
+    explained = res_on.to_dict(explain=True)
+    assert explained["modelFingerprint"]["generation"] == 42
+    assert all(e["modelFingerprint"]["generation"] == 42
+               for e in explained["proposals"])
+
+
+# ------------------------------------------------- fingerprint + verdict units
+
+
+def test_fingerprint_fields_and_age_recompute():
+    now = [1_000_000.0]                     # seconds
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: now[0])
+    comp = _completeness(generation=3, valid_windows=(2, 3, 4),
+                         num_entities=4, avg_available=2, avg_adjacent=1,
+                         forecast=0, valid_ratio=0.75)
+    fp = rec.record_fingerprint(comp, window_ms=1000, dead_brokers=[5, 1],
+                                capacity_source="StaticCapacityResolver",
+                                kind="delta")
+    assert fp["generation"] == 3
+    assert fp["windowEndMs"] == 5 * 1000    # (max valid window + 1) * window
+    assert fp["validWindows"] == 3
+    assert fp["validPartitionRatio"] == 0.75
+    assert fp["deadBrokers"] == [1, 5]
+    assert fp["capacitySource"] == "StaticCapacityResolver"
+    assert fp["kind"] == "delta"
+    denom = 4 * 3
+    assert fp["extrapolatedFraction"] == {
+        "AVG_AVAILABLE": round(2 / denom, 6),
+        "AVG_ADJACENT": round(1 / denom, 6),
+        "FORECAST": 0.0,
+    }
+    assert set(fp["extrapolatedFraction"]) == set(EXTRAPOLATION_KINDS)
+    # ageMs is recomputed at every read against the moving clock.
+    age0 = rec.current_fingerprint()["ageMs"]
+    now[0] += 7.5
+    assert rec.current_fingerprint()["ageMs"] == pytest.approx(
+        age0 + 7500.0, abs=1.0)
+    assert rec.fingerprint_age_ms() == pytest.approx(age0 + 7500.0, abs=1.0)
+    assert rec.state_summary()["modelDeltaApplies"] == 1
+
+
+def test_staleness_reason_strings_and_disabled_defaults():
+    now = [2_000.0]
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: now[0])
+    # No fingerprint yet: never stale, even with thresholds set.
+    rec.configure(enabled=True, min_valid_partition_ratio=0.9, max_age_ms=1)
+    assert rec.staleness_reason() is None
+    assert rec.fingerprint_age_ms() == 0.0          # cold boot never burns
+    assert rec.invalid_partition_ratio() == 0.0
+
+    rec.record_fingerprint(_completeness(valid_ratio=0.5), window_ms=1000)
+    reason = rec.staleness_reason()
+    assert reason == "valid-partition-ratio 0.500 < 0.9"
+    # Ratio passes -> age threshold takes over (windows ended at 5s, now 2000s).
+    rec.configure(enabled=True, min_valid_partition_ratio=0.4,
+                  max_age_ms=60_000)
+    reason = rec.staleness_reason()
+    assert reason.startswith("fingerprint-age ")
+    assert reason.endswith("ms > 60000ms")
+    # Default thresholds (0.0 / 0) mean the gate is off: same fingerprint,
+    # no verdict, and the inverted-validity gauge still reads honestly.
+    rec.configure(enabled=True, min_valid_partition_ratio=0.0, max_age_ms=0)
+    assert rec.staleness_reason() is None
+    assert rec.invalid_partition_ratio() == pytest.approx(0.5)
+
+
+# ------------------------------------------------------- ingest-side units
+
+
+def test_on_fetch_counter_and_last_fetch():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: 12.0)
+    base = registry().counter("Monitor.fetched-samples").count
+    rec.on_fetch(7, 3)
+    assert registry().counter("Monitor.fetched-samples").count == base + 10
+    assert rec.quality()["lastFetch"] == {
+        "partitionSamples": 7, "brokerSamples": 3, "atMs": 12000.0}
+
+
+def test_on_fetch_disabled_counts_but_keeps_no_state():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ModelFidelityRecorder(enabled=False)
+    base = registry().counter("Monitor.fetched-samples").count
+    rec.on_fetch(4, 1)
+    # The fetch HAPPENED — pipeline sensors count regardless; only the
+    # recorder's own state stays untouched.
+    assert registry().counter("Monitor.fetched-samples").count == base + 5
+    assert rec._last_fetch["atMs"] is None
+
+
+def test_on_dropped_causes_and_unknown_cause_raises():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ModelFidelityRecorder(enabled=True)
+    sensors = {"undecodable": "Monitor.dropped-samples-undecodable",
+               "inconsistent": "Monitor.dropped-samples-inconsistent",
+               "out_of_order": "Monitor.out-of-order-samples"}
+    for cause, sensor in sensors.items():
+        base = registry().counter(sensor).count
+        rec.on_dropped(cause, count=3)
+        assert registry().counter(sensor).count == base + 3
+    with pytest.raises(ValueError):
+        rec.on_dropped("cosmic_rays")
+
+
+def test_on_window_close_ring_latency_and_history_event():
+    from cruise_control_tpu.common.metrics import registry
+    from cruise_control_tpu.obsvc.history import history
+    rec = ModelFidelityRecorder(enabled=True)
+    base = registry().counter("Monitor.window-closes").count
+    rec.on_window_close(4, 1000, now_ms=5250.0)      # window [4000,5000)
+    assert registry().counter("Monitor.window-closes").count == base + 1
+    ring = rec.quality()["windowQuality"]
+    assert ring[-1] == {"window": 4, "windowEndMs": 5000,
+                        "closedAtMs": 5250.0, "ingestCommitMs": 250.0}
+    # The event-driven history sample landed at the close timestamp.
+    pts = history().series("Monitor.window-closes")
+    assert [5250.0, float(base + 1)] in pts
+    # A close stamped before its own window end clamps latency at zero.
+    rec.on_window_close(5, 1000, now_ms=5500.0)
+    assert rec.quality()["windowQuality"][-1]["ingestCommitMs"] == 0.0
+
+
+def test_on_window_close_disabled_still_counts_no_ring():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ModelFidelityRecorder(enabled=False)
+    base = registry().counter("Monitor.window-closes").count
+    rec.on_window_close(1, 1000, now_ms=2100.0)
+    assert registry().counter("Monitor.window-closes").count == base + 1
+    assert rec._windows.maxlen and len(rec._windows) == 0
+
+
+def test_record_liveness_flap_detection():
+    from cruise_control_tpu.common.metrics import registry
+    rec = ModelFidelityRecorder(enabled=True)
+    counter = registry().counter("Monitor.broker-liveness-flaps")
+    base = counter.count
+    rec.record_liveness({0: True, 1: True}, now_ms=1.0)
+    assert counter.count == base            # first observation: no flap
+    rec.record_liveness({0: True, 1: False}, now_ms=2.0)
+    assert counter.count == base + 1        # broker 1 flipped
+    rec.record_liveness({0: True, 1: False}, now_ms=3.0)
+    assert counter.count == base + 1        # steady state: no flap
+    rec.record_liveness({0: False, 1: True}, now_ms=4.0)
+    assert counter.count == base + 3        # both flipped
+    flaps = rec.quality()["livenessFlaps"]
+    assert flaps[0] == {"broker": 1, "alive": False, "atMs": 2.0}
+    assert {(f["broker"], f["alive"]) for f in flaps[-2:]} == {
+        (0, False), (1, True)}
+
+
+def test_ring_bounds_and_resize_preserves_entries():
+    rec = ModelFidelityRecorder(enabled=True, ring_size=4)
+    for g in range(6):
+        rec.record_fingerprint(_completeness(generation=g), window_ms=1000)
+    fps = rec.quality()["recentFingerprints"]
+    assert [f["generation"] for f in fps] == [2, 3, 4, 5]   # oldest evicted
+    rec.configure(enabled=True, ring_size=8)
+    fps = rec.quality()["recentFingerprints"]
+    assert [f["generation"] for f in fps] == [2, 3, 4, 5]   # survived resize
+    rec.record_fingerprint(_completeness(generation=6), window_ms=1000)
+    assert len(rec.quality()["recentFingerprints"]) == 5
+
+
+def test_record_fingerprint_disabled_returns_none():
+    rec = ModelFidelityRecorder(enabled=False)
+    assert rec.record_fingerprint(_completeness(), window_ms=1000) is None
+    assert rec.current_fingerprint() is None
+    assert rec.quality()["fingerprint"] is None
+
+
+def test_fingerprint_with_no_valid_windows():
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: 100.0)
+    fp = rec.record_fingerprint(
+        _completeness(valid_windows=(), num_entities=0, avg_available=0,
+                      avg_adjacent=0, forecast=0, valid_ratio=0.0),
+        window_ms=1000)
+    assert fp["windowEndMs"] is None and fp["ageMs"] is None
+    assert fp["validWindows"] == 0
+    assert rec.fingerprint_age_ms() == 0.0      # ageless, not infinitely old
+    # Age threshold cannot fire without a window end; ratio still can.
+    rec.configure(enabled=True, max_age_ms=1)
+    assert rec.staleness_reason() is None
+    rec.configure(enabled=True, min_valid_partition_ratio=0.5)
+    assert "valid-partition-ratio" in rec.staleness_reason()
+
+
+def test_gauge_reads_from_current_fingerprint():
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: 100.0)
+    assert rec.valid_partition_ratio() == 0.0
+    assert rec.extrapolated_fraction() == 0.0
+    rec.record_fingerprint(
+        _completeness(num_entities=10, valid_windows=(0, 1), avg_available=4,
+                      avg_adjacent=2, forecast=2, valid_ratio=0.8),
+        window_ms=1000)
+    assert rec.valid_partition_ratio() == pytest.approx(0.8)
+    assert rec.invalid_partition_ratio() == pytest.approx(0.2)
+    assert rec.extrapolated_fraction() == pytest.approx(8 / 20)
+
+
+def test_quality_and_state_summary_shapes():
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: 50.0)
+    rec.record_fingerprint(_completeness(), window_ms=1000)
+    rec.record_fingerprint(_completeness(), window_ms=1000, kind="delta")
+    q = rec.quality()
+    assert set(q) == {"enabled", "fingerprint", "stale", "thresholds",
+                      "windowQuality", "recentFingerprints", "livenessFlaps",
+                      "lastFetch"}
+    assert q["thresholds"] == {"minValidPartitionRatio": 0.0, "maxAgeMs": 0}
+    s = rec.state_summary()
+    assert s["modelFreezes"] == 1 and s["modelDeltaApplies"] == 1
+    assert s["ringSize"] == 64 and s["fingerprint"]["kind"] == "delta"
+
+
+def test_reset_clears_all_state():
+    rec = ModelFidelityRecorder(enabled=True, clock=lambda: 50.0)
+    rec.on_fetch(1, 1)
+    rec.on_window_close(0, 1000, now_ms=1100.0)
+    rec.record_liveness({0: True}, now_ms=1.0)
+    rec.record_liveness({0: False}, now_ms=2.0)
+    rec.record_fingerprint(_completeness(), window_ms=1000)
+    rec.reset()
+    assert rec.current_fingerprint() is None
+    q = rec.quality()
+    assert q["windowQuality"] == [] and q["livenessFlaps"] == []
+    assert q["recentFingerprints"] == [] and q["lastFetch"]["atMs"] is None
+    assert rec.state_summary()["modelFreezes"] == 0
+
+
+def test_sensors_registered_eagerly():
+    """The drift guard requires every documented sensor to exist before any
+    traffic: register_sensors() ran at import time."""
+    from cruise_control_tpu.common.metrics import registry
+    snap = registry().snapshot()
+    for name in ("Monitor.fingerprint-age-ms", "Monitor.valid-partition-ratio",
+                 "Monitor.invalid-partition-ratio",
+                 "Monitor.extrapolated-fraction", "Monitor.fetched-samples",
+                 "Monitor.stored-samples", "Monitor.out-of-order-samples",
+                 "Monitor.dropped-samples-undecodable",
+                 "Monitor.dropped-samples-inconsistent",
+                 "Monitor.window-closes", "Monitor.broker-liveness-flaps",
+                 "Monitor.model-freezes", "Monitor.model-delta-applies",
+                 "Monitor.stale-model-gates",
+                 "Monitor.ingest-commit-latency-ms"):
+        assert name in snap, f"{name} not registered at import"
+
+
+# --------------------------------------------------------- staleness gate
+
+
+def test_stale_gate_vetoes_self_healing_but_not_propose_traffic():
+    """Acceptance: with a forced-stale model, an anomaly fix dispatch lands
+    an IGNORED `stale_model` audit entry (fingerprint attached) and never
+    starts, while user propose traffic still serves — tagged modelStale."""
+    from cruise_control_tpu.common.metrics import registry
+    from cruise_control_tpu.detector.anomalies import (
+        GoalViolations,
+        SloViolationAnomaly,
+    )
+    from cruise_control_tpu.obsvc.audit import audit_log
+    from tests.test_facade import build_stack
+
+    cc, backend, cluster = build_stack()
+    rec = fidelity()
+    prev = (rec.enabled, rec.min_valid_partition_ratio, rec.max_age_ms)
+    audit_log().clear()
+    gate_counter = registry().counter("Monitor.stale-model-gates")
+    base_gates = gate_counter.count
+    try:
+        # Any fingerprint from the synthetic stack is ancient by wall clock
+        # (sample windows start at epoch 0), so max_age_ms=1 forces STALE.
+        rec.configure(enabled=True, min_valid_partition_ratio=0.0,
+                      max_age_ms=1)
+        rec.record_fingerprint(_completeness(generation=11), window_ms=1000)
+        assert rec.staleness_reason() is not None
+
+        fixed = cc._fix_anomaly(
+            GoalViolations(fixable=["ReplicaDistributionGoal"]))
+        assert fixed is False                       # the fix never starts
+        assert not cc.executor.has_ongoing_execution
+        assert gate_counter.count == base_gates + 1
+        entries = [e for e in audit_log().entries()
+                   if e["anomalyType"] == "GOAL_VIOLATION"]
+        assert entries, audit_log().entries()
+        entry = entries[-1]
+        assert entry["decision"] == "IGNORED"
+        assert entry["description"]["reason"] == "stale_model"
+        assert "fingerprint-age" in entry["description"]["detail"]
+        assert entry["description"]["fingerprint"]["generation"] == 11
+        assert entry["outcome"] is None             # no FIX ever recorded
+
+        # SloViolationAnomaly is exempt: no model data behind its fix.  The
+        # gate must not veto it (it fails later for unrelated reasons or
+        # dispatches normally — here we only assert no stale_model entry).
+        cc._fix_anomaly(SloViolationAnomaly(
+            objective="solve-time", sensor="GoalOptimizer.x",
+            threshold=100.0, worst_value=250.0,
+            burn_rate_short=3.0, burn_rate_long=2.0))
+        assert gate_counter.count == base_gates + 1     # still just one
+        slo_stale = [e for e in audit_log().entries()
+                     if e["anomalyType"] == "SLO_VIOLATION"
+                     and isinstance(e["description"], dict)
+                     and e["description"].get("reason") == "stale_model"]
+        assert slo_stale == []
+
+        # Propose traffic is advisory-only: it serves, tagged modelStale.
+        r = cc.rebalance(goals=["ReplicaDistributionGoal"], dryrun=True)
+        assert r.dryrun and not r.executed
+        assert r.model_stale is True
+        assert r.to_dict()["modelStale"] is True
+        # The solve froze a fresh model, so the result carries its own
+        # (still wall-clock-stale) fingerprint.
+        assert r.optimizer_result.fingerprint is not None
+        state = cc.state()
+        mq = state["MonitorState"]["modelQualityState"]
+        assert mq["enabled"] and mq["stale"] is not None
+        assert state["MonitorState"]["numValidWindows"] == 5
+    finally:
+        rec.configure(enabled=prev[0], min_valid_partition_ratio=prev[1],
+                      max_age_ms=prev[2])
+        rec.reset()
+        audit_log().clear()
+        cc.anomaly_detector.shutdown()
+
+
+# ------------------------------------- /model_quality during a storm cycle
+
+
+def _http_get(port, endpoint):
+    url = f"http://127.0.0.1:{port}/kafkacruisecontrol/{endpoint}"
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_model_quality_served_during_storm_cycle():
+    """Acceptance: GET /model_quality answers 404 while disabled and serves
+    the fingerprint + window-quality payload over HTTP while a storm-runner
+    execution is in flight."""
+    from cruise_control_tpu.fuzzsvc.scenario import generate_scenario
+    from cruise_control_tpu.fuzzsvc.storm import _wait_idle, build_storm_stack
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+
+    rec = fidelity()
+    prev = (rec.enabled, rec.min_valid_partition_ratio, rec.max_age_ms)
+    sc = generate_scenario(4146, kind="exp_skew")
+    stack = build_storm_stack(sc, num_brokers=6, partitions=16, rf=2,
+                              polls_to_finish=10)
+    stack.cc.executor.adjuster.current = 1
+    stack.cc.executor.adjuster.max_concurrency = 1
+    stack.cc.executor.config.concurrent_leader_movements = 1
+    app = CruiseControlApp(stack.cc, port=0)
+    app.start()
+    try:
+        rec.configure(enabled=False)
+        status, body = _http_get(app.port, "model_quality")
+        assert status == 404 and "disabled" in body["error"]
+
+        rec.configure(enabled=True, min_valid_partition_ratio=0.0,
+                      max_age_ms=0)
+        res = stack.cc.rebalance(dryrun=False)
+        assert res.executed
+        solved_fp = res.optimizer_result.fingerprint
+        assert solved_fp is not None                # freeze stamped the solve
+
+        live = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if not stack.cc.executor.has_ongoing_execution:
+                break
+            status, body = _http_get(app.port, "model_quality")
+            assert status == 200
+            live = body
+            time.sleep(0.001)
+        assert live is not None, "never polled mid-execution"
+        assert live["enabled"] is True
+        assert live["stale"] is None                # thresholds at defaults
+        fp = live["fingerprint"]
+        assert fp is not None
+        assert fp["generation"] == solved_fp["generation"]
+        assert fp["validWindows"] > 0
+        assert set(fp["extrapolatedFraction"]) == set(EXTRAPOLATION_KINDS)
+        assert live["recentFingerprints"], "freeze not in the ring"
+        assert live["thresholds"] == {"minValidPartitionRatio": 0.0,
+                                      "maxAgeMs": 0}
+
+        assert _wait_idle(stack.cc, timeout_s=60.0)
+        # The executor journaled the generation it acted on (joined lineage).
+        status, body = _http_get(app.port, "state")
+        assert status == 200
+        mq = body["MonitorState"]["modelQualityState"]
+        assert mq["enabled"] and mq["modelFreezes"] >= 1
+        assert mq["fingerprint"]["generation"] == solved_fp["generation"]
+    finally:
+        app.stop()
+        stack.cc.anomaly_detector.shutdown()
+        rec.configure(enabled=prev[0], min_valid_partition_ratio=prev[1],
+                      max_age_ms=prev[2])
+        rec.reset()

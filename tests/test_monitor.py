@@ -148,6 +148,107 @@ def test_retain_entities():
     assert agg.all_entities() == [("t", 0)]
 
 
+def test_out_of_order_samples_dropped_with_counter():
+    from cruise_control_tpu.common.metrics import registry
+    ctr = registry().counter("Monitor.out-of-order-samples")
+    agg = _agg()
+    e = ("t", 0)
+    fill(agg, e, [0, 1, 2, 3])
+    before = ctr.count
+    # Window 1 closed when window 3 became active: the late sample must be
+    # dropped (it would scatter into a committed buffer), counted once.
+    assert agg.add_sample(e, 1 * W + 500, _metrics()) is False
+    assert ctr.count == before + 1
+    # The still-active window is NOT out of order.
+    assert agg.add_sample(e, 3 * W + 500, _metrics()) is True
+    assert ctr.count == before + 1
+    # A batch that spans the window it advances past keeps its in-ring part.
+    n = agg.add_samples([e, e], np.array([3 * W + 600.0, 4 * W + 10.0]),
+                        np.stack([_metrics(), _metrics()]))
+    assert n == 2 and ctr.count == before + 1
+
+
+def test_first_batch_ingest_exempt_from_out_of_order_drop():
+    from cruise_control_tpu.common.metrics import registry
+    ctr = registry().counter("Monitor.out-of-order-samples")
+    before = ctr.count
+    agg = _agg()
+    e = ("t", 0)
+    # A batched bootstrap replay arrives oldest-first in ONE call: the roll
+    # to the newest window must not retro-drop the older windows' samples.
+    n = agg.add_samples([e] * 3,
+                        np.array([10.0, W + 10.0, 2 * W + 10.0]),
+                        np.stack([_metrics()] * 3))
+    assert n == 3
+    assert ctr.count == before
+
+
+def test_no_valid_extrapolation_invalidates_entity():
+    # Leading empty window with no prior history and an empty right
+    # neighbor: no extrapolation kind applies (NO_VALID_EXTRAPOLATION), so
+    # the entity drops out of the aggregation entirely.
+    agg = _agg()
+    good, bad = ("t", 0), ("t", 1)
+    fill(agg, good, [0, 1, 2, 3, 4])
+    fill(agg, bad, [2, 3, 4])                      # windows 0,1 unfillable
+    fill(agg, good, [5], per_window=1)             # active
+    res = agg.aggregate(0, 6 * W)
+    assert bad not in res.values_and_extrapolations
+    comp = res.completeness
+    assert comp.num_valid_entities == 1
+    # By-kind counts cover VALID entities only — the invalid one must not
+    # leak its (nonexistent) fills into the fingerprint accounting.
+    assert (comp.num_windows_avg_available + comp.num_windows_avg_adjacent
+            + comp.num_windows_forecast) == 0
+    assert comp.num_entity_windows == len(comp.valid_windows)
+
+
+def test_max_extrapolations_overflow_flips_entity_invalid():
+    # Two AVG_AVAILABLE windows: under a cap of 1 the entity overflows its
+    # extrapolation budget and flips invalid; a cap of 2 keeps it valid.
+    def build(cap):
+        agg = _agg(max_allowed_extrapolations_per_entity=cap)
+        e = ("t", 0)
+        fill(agg, e, [0, 1, 2])
+        agg.add_sample(e, 3 * W + 10, _metrics())  # 1 < min_samples
+        agg.add_sample(e, 4 * W + 10, _metrics())  # 1 < min_samples
+        fill(agg, e, [5], per_window=1)            # active
+        return e, agg.aggregate(0, 6 * W)
+
+    e, res = build(cap=2)
+    assert e in res.values_and_extrapolations
+    assert res.completeness.num_windows_avg_available == 2
+    e, res = build(cap=1)
+    assert e not in res.values_and_extrapolations
+    assert res.completeness.num_valid_entities == 0
+    assert res.completeness.num_windows_avg_available == 0
+
+
+def test_completeness_by_kind_counts_match_recount():
+    # Mixed gap pattern across two entities; the completeness by-kind
+    # tallies must equal an independent recount of the per-entity
+    # extrapolation maps (the fingerprint_coherent fuzz invariant's check,
+    # pinned here as a unit test).
+    agg = _agg(max_allowed_extrapolations_per_entity=4)
+    a, b = ("t", 0), ("t", 1)
+    fill(agg, a, [0, 1, 3, 4])
+    agg.add_sample(a, 2 * W + 10, _metrics())      # 1 < min: AVG_AVAILABLE
+    fill(agg, b, [0, 1, 2])                        # w3, w4 empty: FORECAST
+    fill(agg, a, [5], per_window=1)                # active
+    res = agg.aggregate(0, 6 * W)
+    recount = {Extrapolation.AVG_AVAILABLE: 0, Extrapolation.AVG_ADJACENT: 0,
+               Extrapolation.FORECAST: 0}
+    for vae in res.values_and_extrapolations.values():
+        for kind in vae.extrapolations.values():
+            recount[kind] += 1
+    comp = res.completeness
+    assert comp.num_windows_avg_available == recount[Extrapolation.AVG_AVAILABLE]
+    assert comp.num_windows_avg_adjacent == recount[Extrapolation.AVG_ADJACENT]
+    assert comp.num_windows_forecast == recount[Extrapolation.FORECAST]
+    assert comp.num_entity_windows == (comp.num_valid_entities
+                                       * len(comp.valid_windows))
+
+
 # ------------------------------------------------------------- load monitor
 
 
